@@ -1,0 +1,17 @@
+"""foundationdb_tpu — a TPU-native distributed transactional key-value
+framework with the capabilities of FoundationDB (reference: atn34/foundationdb
+@ 6.1.0, surveyed in SURVEY.md).
+
+Layer map (mirrors the reference bottom-up; see SURVEY.md section 1):
+  runtime/   deterministic async core + simulation clock   (flow/, Sim2)
+  rpc/       sim network, typed endpoints, failure monitor (fdbrpc/)
+  keys.py    fixed-width key encoding for device kernels
+  ops/       JAX building blocks (search, RMQ, bitset scans)
+  conflict/  the OCC ConflictSet: oracle, native C++, TPU   (fdbserver/SkipList.cpp)
+  parallel/  multi-device sharded resolver (shard_map+psum) (multi-resolver split)
+  roles/     sequencer, proxy, resolver, tlog, storage      (fdbserver/)
+  client/    Transaction + ReadYourWrites                   (fdbclient/)
+  workloads/ simulation test workloads                      (fdbserver/workloads/)
+"""
+
+__version__ = "0.1.0"

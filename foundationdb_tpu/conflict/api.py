@@ -1,0 +1,83 @@
+"""ConflictSet plugin API — the seam where the TPU backend slots in.
+
+Semantics mirror the reference's ConflictSet interface
+(fdbserver/ConflictSet.h:27-60) and its use by the Resolver
+(fdbserver/Resolver.actor.cpp:140-157):
+
+  * A *batch* of transactions arrives with one commit version for the whole
+    batch (assigned by the sequencer, fdbserver/masterserver.actor.cpp:831).
+  * Each transaction carries a read snapshot version, read conflict ranges,
+    and write conflict ranges (fdbclient/CommitTransaction.h:89).
+  * Verdicts (reference ConflictBatch::TransactionCommitted enum):
+      - TOO_OLD      if read_snapshot < oldest_version (the MVCC window floor;
+                     detected at add time, SkipList.cpp:985)
+      - CONFLICT     if any read range intersects a write range committed at a
+                     version v with read_snapshot < v  (history conflict,
+                     SkipList.cpp:1210), or intersects a write range of an
+                     *earlier committed* transaction in the same batch
+                     (intra-batch, SkipList.cpp:1133-1152 — order matters:
+                     later transactions see earlier committed writes only)
+      - COMMITTED    otherwise; its write ranges are then inserted at the
+                     batch's commit version (SkipList.cpp:1260).
+  * remove_before(v) garbage-collects write ranges with version < v and
+    raises the TOO_OLD floor (SkipList.cpp:665).
+
+Ranges are half-open [begin, end) over byte-string keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence
+
+
+class Verdict(enum.IntEnum):
+    # Values match the reference's ConflictBatch::TransactionCommitted
+    # (fdbserver/ConflictSet.h:42-46) order: conflict, committed, too_old.
+    CONFLICT = 0
+    COMMITTED = 1
+    TOO_OLD = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TxInfo:
+    """One transaction's conflict-relevant payload
+    (fdbclient/CommitTransaction.h:89 CommitTransactionRef)."""
+
+    read_snapshot: int
+    read_ranges: Sequence[tuple[bytes, bytes]]
+    write_ranges: Sequence[tuple[bytes, bytes]]
+
+
+class ConflictSet:
+    """Abstract conflict set; implementations: oracle (conflict/oracle.py),
+    native C++ (conflict/native.py), TPU (conflict/tpu.py)."""
+
+    def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
+        """Check all txns against history + each other; insert committed
+        txns' writes at commit_version; return per-txn verdicts."""
+        raise NotImplementedError
+
+    def remove_before(self, version: int) -> None:
+        """GC write ranges older than `version`; txns with read_snapshot <
+        version become TOO_OLD."""
+        raise NotImplementedError
+
+    @property
+    def oldest_version(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:  # destroyConflictSet analog
+        pass
+
+
+def validate_batch(commit_version: int, txns: Sequence[TxInfo], oldest: int) -> None:
+    if commit_version < oldest:
+        raise ValueError(f"commit_version {commit_version} < oldest_version {oldest}")
+    for t in txns:
+        if t.read_snapshot >= commit_version:
+            raise ValueError("read_snapshot must precede commit_version")
+        for b, e in list(t.read_ranges) + list(t.write_ranges):
+            if not (isinstance(b, bytes) and isinstance(e, bytes)):
+                raise TypeError("range endpoints must be bytes")

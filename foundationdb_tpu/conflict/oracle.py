@@ -1,0 +1,122 @@
+"""Pure-Python interval-map oracle — the abort-set parity referee.
+
+Port of the *semantics* (not the code) of the reference's SlowConflictSet
+(fdbserver/SkipList.cpp:59-88): a step function over the key space mapping
+each key to the newest commit version that wrote it, kept as a sorted list of
+(boundary, version) pairs.  A read range [b, e) at snapshot s conflicts iff
+max{version over [b, e)} > s.  Deliberately simple and obviously correct;
+used by tests to referee the native and TPU implementations.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Sequence
+
+from .api import ConflictSet, TxInfo, Verdict, validate_batch
+
+
+class _StepFunction:
+    """Piecewise-constant int over byte-string key space."""
+
+    def __init__(self) -> None:
+        self._keys: list[bytes] = [b""]
+        self._vals: list[int] = [0]
+
+    def query_max(self, begin: bytes, end: bytes) -> int:
+        if begin >= end:
+            return 0
+        lo = bisect.bisect_right(self._keys, begin) - 1
+        hi = bisect.bisect_left(self._keys, end)
+        return max(self._vals[lo:hi])
+
+    def assign(self, begin: bytes, end: bytes, version: int) -> None:
+        """Set value over [begin, end) to `version` (plain assignment with
+        boundary splitting; callers guarantee monotonically increasing
+        versions — enforced in resolve_batch)."""
+        if begin >= end:
+            return
+        ks, vs = self._keys, self._vals
+        # value just right of `end` must be preserved: split at end
+        hi = bisect.bisect_right(ks, end) - 1
+        end_val = vs[hi]
+        lo = bisect.bisect_right(ks, begin) - 1
+        # remove boundaries strictly inside (begin, end), insert begin/end
+        i0 = lo + 1 if ks[lo] < begin else lo
+        new_keys = ks[:i0] + [begin, end]
+        new_vals = vs[:i0] + [version, end_val]
+        j = bisect.bisect_right(ks, end)  # boundaries strictly after end kept
+        new_keys += ks[j:]
+        new_vals += vs[j:]
+        self._keys, self._vals = new_keys, new_vals
+        self._coalesce()
+
+    def _coalesce(self) -> None:
+        ks, vs = self._keys, self._vals
+        nk, nv = [ks[0]], [vs[0]]
+        for k, v in zip(ks[1:], vs[1:]):
+            if v != nv[-1]:
+                nk.append(k)
+                nv.append(v)
+        self._keys, self._vals = nk, nv
+
+    def clamp_below(self, floor: int) -> None:
+        self._vals = [0 if v < floor else v for v in self._vals]
+        self._coalesce()
+
+
+def ranges_overlap(a: tuple[bytes, bytes], b: tuple[bytes, bytes]) -> bool:
+    return a[0] < b[1] and b[0] < a[1]
+
+
+class OracleConflictSet(ConflictSet):
+    def __init__(self, oldest_version: int = 0) -> None:
+        self._history = _StepFunction()
+        self._oldest = oldest_version
+        self._last_commit = oldest_version
+
+    @property
+    def oldest_version(self) -> int:
+        return self._oldest
+
+    def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
+        validate_batch(commit_version, txns, self._oldest)
+        if commit_version <= self._last_commit:
+            raise ValueError(
+                f"commit_version {commit_version} not after last batch {self._last_commit}"
+                " (versions are assigned monotonically by the sequencer,"
+                " reference masterserver.actor.cpp:831)"
+            )
+        self._last_commit = commit_version
+        verdicts: list[Verdict] = []
+        batch_writes = _StepFunction()  # committed-so-far within this batch
+        committed_writes: list[tuple[bytes, bytes]] = []
+        for t in txns:
+            if t.read_snapshot < self._oldest:
+                verdicts.append(Verdict.TOO_OLD)
+                continue
+            conflict = False
+            for b, e in t.read_ranges:
+                if b >= e:
+                    continue
+                if self._history.query_max(b, e) > t.read_snapshot:
+                    conflict = True
+                    break
+                if batch_writes.query_max(b, e) > 0:
+                    conflict = True
+                    break
+            if conflict:
+                verdicts.append(Verdict.CONFLICT)
+                continue
+            verdicts.append(Verdict.COMMITTED)
+            for b, e in t.write_ranges:
+                batch_writes.assign(b, e, 1)
+                committed_writes.append((b, e))
+        for b, e in committed_writes:
+            self._history.assign(b, e, commit_version)
+        return verdicts
+
+    def remove_before(self, version: int) -> None:
+        if version > self._oldest:
+            self._oldest = version
+            self._history.clamp_below(version)

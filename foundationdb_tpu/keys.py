@@ -1,0 +1,101 @@
+"""Fixed-width key encoding for device kernels.
+
+FoundationDB compares keys as arbitrary byte strings (reference:
+fdbserver/SkipList.cpp:147-196 builds an elaborate per-byte ordering for its
+sort; flow/Arena.h StringRef::compare is plain memcmp).  TPU kernels need
+fixed-width lanes, so we encode a key of up to ``4*num_words`` bytes as
+``num_words`` big-endian uint32 words (zero padded) followed by one length
+word:
+
+    enc(k) = (w_0, ..., w_{n-1}, len(k))
+
+Lexicographic order over the ``n+1`` uint32 lanes equals byte-string order:
+the first differing padded byte decides, and when one key is a zero-padded
+prefix of the other (including trailing-NUL cases like ``b"a"`` vs
+``b"a\\x00"``) the length word breaks the tie exactly as memcmp-then-length
+does.
+
+A sentinel of all-0xFFFFFFFF lanes sorts strictly after every real key
+(real keys have length <= 4*num_words < 2**32) and is used to pad unused
+slots in device arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Default: 32-byte keys -> 8 data words + 1 length word.  The reference's
+# published benchmarks use 16-byte keys (documentation/sphinx/source/
+# performance.rst:14); 32 gives headroom for tuple-encoded keys.
+DEFAULT_MAX_KEY_BYTES = 32
+
+
+def num_words(max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -> int:
+    """Total lanes per encoded key (data words + 1 length word)."""
+    if max_key_bytes <= 0 or max_key_bytes % 4:
+        raise ValueError("max_key_bytes must be a positive multiple of 4")
+    return max_key_bytes // 4 + 1
+
+
+def sentinel(max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -> np.ndarray:
+    """A key greater than any encodable key; pads unused device slots."""
+    return np.full((num_words(max_key_bytes),), 0xFFFFFFFF, dtype=np.uint32)
+
+
+def encode_keys(keys: list[bytes], max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -> np.ndarray:
+    """Encode a list of byte keys -> uint32[len(keys), num_words].
+
+    Raises KeyTooLongError for keys longer than max_key_bytes; callers that
+    must handle arbitrary-length keys (FDB allows up to 10KB) catch this and
+    route the batch to a host-side implementation (see conflict/tpu.py).
+    """
+    kw = num_words(max_key_bytes) - 1  # validates max_key_bytes
+    n = len(keys)
+    out = np.zeros((n, kw + 1), dtype=np.uint32)
+    if n == 0:
+        return out
+    buf = np.zeros((n, max_key_bytes), dtype=np.uint8)
+    for i, k in enumerate(keys):
+        if len(k) > max_key_bytes:
+            raise KeyTooLongError(f"key of {len(k)} bytes exceeds {max_key_bytes}")
+        buf[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+        out[i, kw] = len(k)
+    # big-endian word packing: byte j contributes << (8 * (3 - j%4))
+    words = (
+        (buf[:, 0::4].astype(np.uint32) << 24)
+        | (buf[:, 1::4].astype(np.uint32) << 16)
+        | (buf[:, 2::4].astype(np.uint32) << 8)
+        | (buf[:, 3::4].astype(np.uint32))
+    )
+    out[:, :kw] = words
+    return out
+
+
+def decode_key(enc: np.ndarray) -> bytes:
+    """Inverse of encode_keys for a single encoded key."""
+    kw = enc.shape[-1] - 1
+    length = int(enc[kw])
+    b = bytearray()
+    for w in range(kw):
+        v = int(enc[w])
+        b += bytes(((v >> 24) & 0xFF, (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF))
+    return bytes(b[:length])
+
+
+class KeyTooLongError(ValueError):
+    pass
+
+
+def key_after(key: bytes) -> bytes:
+    """First key strictly after ``key``: key + b'\\x00' (reference:
+    fdbclient/FDBTypes.h keyAfter)."""
+    return key + b"\x00"
+
+
+def strinc(key: bytes) -> bytes:
+    """First key not prefixed by ``key`` (reference: flow strinc): strip
+    trailing 0xFF bytes then increment the last byte."""
+    k = key.rstrip(b"\xff")
+    if not k:
+        raise ValueError("strinc of all-0xFF key has no upper bound")
+    return k[:-1] + bytes([k[-1] + 1])

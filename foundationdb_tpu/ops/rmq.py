@@ -1,0 +1,97 @@
+"""Static-shape range-max/min machinery for the conflict kernel.
+
+Two dual primitives, both O(N log N) fully-vectorized ops:
+
+  * sparse table (range query, point values): answers max/min over [lo, hi)
+    in O(1) gathers per query — replaces the reference skip list's per-level
+    max-version pyramid (fdbserver/SkipList.cpp:795-831) for the history
+    check "newest committed write version over this read range".
+  * block decomposition (range update, point query): each interval update
+    [lo, hi) with value v lands as two power-of-two block updates at level
+    floor(log2(hi-lo)); a down-sweep pushes levels to points.  min/max are
+    idempotent so colliding scatter updates need no dedup.  Used to compute,
+    per endpoint-gap, the earliest (min-index) transaction writing that gap —
+    the device formulation of MiniConflictSet's ordered bitmask walk
+    (fdbserver/SkipList.cpp:1028-1152).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+U32_MAX = jnp.uint32(0xFFFFFFFF)
+I32_MAX = jnp.int32(0x7FFFFFFF)
+
+
+def _levels(n: int) -> int:
+    return max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+
+
+def floor_log2(x: jnp.ndarray) -> jnp.ndarray:
+    """floor(log2(x)) for x >= 1 (int32)."""
+    return jnp.int32(31) - jax.lax.clz(x.astype(jnp.int32))
+
+
+def build_sparse_table(vals: jnp.ndarray, op, ident) -> jnp.ndarray:
+    """table[l, i] = op-reduce of vals[i : i + 2**l] (identity-padded).
+
+    vals: [N]; returns [L, N]."""
+    n = vals.shape[0]
+    levels = [vals]
+    for l in range(1, _levels(n)):
+        s = 1 << (l - 1)
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[s:], jnp.full((min(s, n),), ident, prev.dtype)])[:n]
+        levels.append(op(prev, shifted))
+    return jnp.stack(levels)
+
+
+def query_sparse_table(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, op, ident) -> jnp.ndarray:
+    """op-reduce over [lo, hi) per query; empty ranges (hi <= lo) -> ident."""
+    n = table.shape[1]
+    nonempty = hi > lo
+    length = jnp.maximum(hi - lo, 1)
+    k = floor_log2(length)
+    pw = (jnp.int32(1) << k)
+    i1 = jnp.clip(lo, 0, n - 1)
+    i2 = jnp.clip(hi - pw, 0, n - 1)
+    a = table[k, i1]
+    b = table[k, i2]
+    out = op(a, b)
+    return jnp.where(nonempty, out, jnp.asarray(ident, table.dtype))
+
+
+def range_update_point_query(
+    n: int, lo: jnp.ndarray, hi: jnp.ndarray, val: jnp.ndarray, mask: jnp.ndarray, op_name: str, ident
+) -> jnp.ndarray:
+    """out[g] = op over {val[j] : mask[j] and lo[j] <= g < hi[j]} (else ident).
+
+    op_name: "min" or "max" (idempotent, so colliding updates are safe).
+    Returns [n]."""
+    L = _levels(n)
+    length = jnp.maximum(hi - lo, 1)
+    k = jnp.where(mask, floor_log2(length), 0)
+    pw = jnp.int32(1) << k
+    v = jnp.where(mask, val, jnp.asarray(ident, val.dtype))
+    p1 = jnp.clip(jnp.where(mask, lo, 0), 0, n - 1)
+    p2 = jnp.clip(jnp.where(mask, hi - pw, 0), 0, n - 1)
+    block = jnp.full((L, n), ident, dtype=val.dtype)
+    if op_name == "min":
+        block = block.at[k, p1].min(v).at[k, p2].min(v)
+        op = jnp.minimum
+    elif op_name == "max":
+        block = block.at[k, p1].max(v).at[k, p2].max(v)
+        op = jnp.maximum
+    else:
+        raise ValueError(op_name)
+    # down-sweep: level l block at i covers [i, i+2**l); push to the two
+    # half-blocks at level l-1 (positions i and i + 2**(l-1)); shifted[i] is
+    # the level-l contribution arriving from position i - 2**(l-1).
+    acc = block[L - 1]
+    for l in range(L - 1, 0, -1):
+        s = 1 << (l - 1)
+        shifted = jnp.concatenate([jnp.full((min(s, n),), ident, acc.dtype), acc[: max(n - s, 0)]])[:n]
+        acc = op(block[l - 1], op(acc, shifted))
+    return acc

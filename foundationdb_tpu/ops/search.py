@@ -1,0 +1,58 @@
+"""Vectorized multiword-key comparison and binary search.
+
+The device-resident conflict state keeps boundary keys as uint32[cap, W]
+word vectors (see keys.py).  History conflict checks need, per query key,
+lower/upper bounds into that sorted array — the TPU replacement for the
+reference's skip-list descent (fdbserver/SkipList.cpp:408-460 `find`).
+Fixed-trip-count binary search: log2(cap) vectorized gather+compare rounds,
+no data-dependent control flow, so XLA compiles it to a tight loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .rmq import _levels
+
+
+def lex_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic a < b over trailing word axis; [..., W] -> [...] bool."""
+    W = a.shape[-1]
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq = jnp.ones(a.shape[:-1], dtype=bool)
+    for w in range(W):
+        aw, bw = a[..., w], b[..., w]
+        lt = lt | (eq & (aw < bw))
+        eq = eq & (aw == bw)
+    return lt
+
+
+def lex_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def _search(sorted_keys: jnp.ndarray, q: jnp.ndarray, go_right) -> jnp.ndarray:
+    n = sorted_keys.shape[0]
+    if n == 0:
+        return jnp.zeros(q.shape[0], dtype=jnp.int32)
+    steps = _levels(n)
+    lo = jnp.zeros(q.shape[0], dtype=jnp.int32)
+    hi = jnp.full(q.shape[0], n, dtype=jnp.int32)
+    for _ in range(steps):
+        active = lo < hi
+        mid = jnp.clip((lo + hi) // 2, 0, n - 1)
+        km = jnp.take(sorted_keys, mid, axis=0)
+        right = go_right(km, q)
+        lo = jnp.where(active & right, mid + 1, lo)
+        hi = jnp.where(active & ~right, mid, hi)
+    return lo
+
+
+def lower_bound(sorted_keys: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """First index i with sorted_keys[i] >= q.  sorted_keys [N, W], q [Q, W]."""
+    return _search(sorted_keys, q, lambda km, qq: lex_less(km, qq))
+
+
+def upper_bound(sorted_keys: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """First index i with sorted_keys[i] > q."""
+    return _search(sorted_keys, q, lambda km, qq: ~lex_less(qq, km))

@@ -1,0 +1,22 @@
+"""Test config: force a fast 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run on XLA's
+host platform with 8 virtual devices (the driver separately dry-run-compiles
+the multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment injects a TPU-tunnel PJRT plugin ("axon") via
+sitecustomize.py in every interpreter and sets JAX_PLATFORMS=axon globally;
+initializing it costs ~2 minutes of tunnel handshake.  Tests must never pay
+that, so we re-point JAX at CPU *after* import (the env var was already
+latched when sitecustomize imported jax) and drop the plugin's backend
+factory before the first op initializes backends.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)

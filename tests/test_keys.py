@@ -1,0 +1,57 @@
+import random
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu import keys
+
+
+def _order_of(enc_row):
+    return tuple(int(x) for x in enc_row)
+
+
+def test_roundtrip():
+    ks = [b"", b"a", b"abc", b"\x00", b"\xff" * 32, bytes(range(20))]
+    enc = keys.encode_keys(ks)
+    for i, k in enumerate(ks):
+        assert keys.decode_key(enc[i]) == k
+
+
+def test_order_matches_bytes_random():
+    rng = random.Random(0)
+    ks = []
+    for _ in range(2000):
+        n = rng.randrange(0, 33)
+        ks.append(bytes(rng.randrange(256) for _ in range(n)))
+    # adversarial: shared prefixes, trailing NULs, trailing 0xFF
+    for base in (b"", b"ab", b"ab\x00", b"\xff\xff", b"prefix"):
+        ks += [base, base + b"\x00", base + b"\x00\x00", base + b"\xff", base + b"\x01"]
+    enc = keys.encode_keys(ks)
+    by_bytes = sorted(range(len(ks)), key=lambda i: ks[i])
+    by_enc = sorted(range(len(ks)), key=lambda i: _order_of(enc[i]))
+    assert [ks[i] for i in by_bytes] == [ks[i] for i in by_enc]
+
+
+def test_sentinel_sorts_last():
+    s = _order_of(keys.sentinel())
+    enc = keys.encode_keys([b"\xff" * 32, b""])
+    assert _order_of(enc[0]) < s and _order_of(enc[1]) < s
+
+
+def test_too_long_raises():
+    with pytest.raises(keys.KeyTooLongError):
+        keys.encode_keys([b"x" * 33])
+
+
+def test_key_after_and_strinc():
+    assert keys.key_after(b"a") == b"a\x00"
+    assert keys.strinc(b"a") == b"b"
+    assert keys.strinc(b"a\xff\xff") == b"b"
+    e = keys.encode_keys([b"a", keys.key_after(b"a"), b"a\x01"])
+    assert _order_of(e[0]) < _order_of(e[1]) < _order_of(e[2])
+
+
+def test_empty_key_is_minimum():
+    enc = keys.encode_keys([b"", b"\x00"])
+    assert _order_of(enc[0]) < _order_of(enc[1])
+    assert np.all(enc[0] == 0)

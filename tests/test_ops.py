@@ -1,0 +1,89 @@
+"""Unit tests for device building blocks vs numpy brute force."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from foundationdb_tpu import keys
+from foundationdb_tpu.ops import rmq, search
+
+
+def _rand_keys(rng, n, max_len=12):
+    return [bytes(rng.integers(0, 256, rng.integers(0, max_len + 1)).astype(np.uint8)) for _ in range(n)]
+
+
+def test_lex_less_matches_bytes():
+    rng = np.random.default_rng(0)
+    ks = _rand_keys(rng, 300) + [b"", b"a", b"a\x00", b"a" * 12]
+    enc = keys.encode_keys(ks, max_key_bytes=16)
+    a = jnp.asarray(enc[: len(ks) // 2 * 2 : 2])
+    b = jnp.asarray(enc[1 : len(ks) // 2 * 2 : 2])
+    got = np.asarray(search.lex_less(a, b))
+    want = np.array([ks[2 * i] < ks[2 * i + 1] for i in range(len(got))])
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n", [1, 7, 64, 100])
+def test_bounds_match_numpy(n):
+    rng = np.random.default_rng(n)
+    pool = sorted(set(_rand_keys(rng, n)))
+    enc_sorted = jnp.asarray(keys.encode_keys(pool, max_key_bytes=16))
+    qs = _rand_keys(rng, 200) + list(pool)
+    qenc = jnp.asarray(keys.encode_keys(qs, max_key_bytes=16))
+    lb = np.asarray(search.lower_bound(enc_sorted, qenc))
+    ub = np.asarray(search.upper_bound(enc_sorted, qenc))
+    for i, q in enumerate(qs):
+        want_lb = sum(1 for k in pool if k < q)
+        want_ub = sum(1 for k in pool if k <= q)
+        assert lb[i] == want_lb, (q, pool)
+        assert ub[i] == want_ub
+
+
+def test_sparse_table_max():
+    rng = np.random.default_rng(1)
+    v = rng.integers(0, 1000, 97).astype(np.uint32)
+    table = rmq.build_sparse_table(jnp.asarray(v), jnp.maximum, 0)
+    los = rng.integers(0, 97, 200)
+    his = rng.integers(0, 98, 200)
+    got = np.asarray(
+        rmq.query_sparse_table(table, jnp.asarray(los, jnp.int32), jnp.asarray(his, jnp.int32), jnp.maximum, 0)
+    )
+    for i in range(200):
+        want = v[los[i] : his[i]].max() if his[i] > los[i] else 0
+        assert got[i] == want
+
+
+def test_range_update_point_query_min():
+    rng = np.random.default_rng(2)
+    n, j = 113, 64
+    lo = rng.integers(0, n, j).astype(np.int32)
+    hi = np.minimum(lo + rng.integers(1, 40, j), n).astype(np.int32)
+    val = rng.integers(0, 500, j).astype(np.int32)
+    mask = rng.random(j) < 0.8
+    got = np.asarray(
+        rmq.range_update_point_query(
+            n, jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(val), jnp.asarray(mask), "min", rmq.I32_MAX
+        )
+    )
+    want = np.full(n, int(rmq.I32_MAX), np.int64)
+    for t in range(j):
+        if mask[t]:
+            want[lo[t] : hi[t]] = np.minimum(want[lo[t] : hi[t]], val[t])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_range_update_point_query_max():
+    rng = np.random.default_rng(3)
+    n, j = 64, 40
+    lo = rng.integers(0, n, j).astype(np.int32)
+    hi = np.minimum(lo + rng.integers(1, 20, j), n).astype(np.int32)
+    val = rng.integers(1, 500, j).astype(np.uint32)
+    mask = np.ones(j, bool)
+    got = np.asarray(
+        rmq.range_update_point_query(n, jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(val), jnp.asarray(mask), "max", 0)
+    )
+    want = np.zeros(n, np.int64)
+    for t in range(j):
+        want[lo[t] : hi[t]] = np.maximum(want[lo[t] : hi[t]], val[t])
+    np.testing.assert_array_equal(got, want)
