@@ -54,12 +54,20 @@ def encode_keys(keys: list[bytes], max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -
     out = np.zeros((n, kw + 1), dtype=np.uint32)
     if n == 0:
         return out
-    buf = np.zeros((n, max_key_bytes), dtype=np.uint8)
-    for i, k in enumerate(keys):
-        if len(k) > max_key_bytes:
-            raise KeyTooLongError(f"key of {len(k)} bytes exceeds {max_key_bytes}")
-        buf[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
-        out[i, kw] = len(k)
+    lens = np.fromiter((len(k) for k in keys), count=n, dtype=np.int64)
+    if lens.max() > max_key_bytes:
+        i = int(np.argmax(lens))
+        raise KeyTooLongError(f"key of {len(keys[i])} bytes exceeds {max_key_bytes}")
+    # Vectorized gather from the concatenated byte stream (hot path: the
+    # resolver encodes every conflict-range endpoint of every batch).
+    flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    starts = np.zeros(n, dtype=np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    cols = np.arange(max_key_bytes, dtype=np.int64)
+    mask = cols[None, :] < lens[:, None]
+    idx = np.minimum(starts[:, None] + cols[None, :], max(len(flat) - 1, 0))
+    buf = np.where(mask, flat[idx] if len(flat) else np.uint8(0), np.uint8(0))
+    out[:, kw] = lens
     # big-endian word packing: byte j contributes << (8 * (3 - j%4))
     words = (
         (buf[:, 0::4].astype(np.uint32) << 24)

@@ -10,6 +10,7 @@ no data-dependent control flow, so XLA compiles it to a tight loop.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .rmq import _levels
@@ -36,15 +37,23 @@ def _search(sorted_keys: jnp.ndarray, q: jnp.ndarray, go_right) -> jnp.ndarray:
     if n == 0:
         return jnp.zeros(q.shape[0], dtype=jnp.int32)
     steps = _levels(n)
-    lo = jnp.zeros(q.shape[0], dtype=jnp.int32)
-    hi = jnp.full(q.shape[0], n, dtype=jnp.int32)
-    for _ in range(steps):
+
+    # fori_loop rather than Python unrolling: the body compiles once, keeping
+    # XLA compile time flat in log(n) (unrolled, ~10 searches dominated the
+    # whole conflict kernel's compile).
+    def body(_, state):
+        lo, hi = state
         active = lo < hi
         mid = jnp.clip((lo + hi) // 2, 0, n - 1)
         km = jnp.take(sorted_keys, mid, axis=0)
         right = go_right(km, q)
         lo = jnp.where(active & right, mid + 1, lo)
         hi = jnp.where(active & ~right, mid, hi)
+        return lo, hi
+
+    lo = jnp.zeros(q.shape[0], dtype=jnp.int32)
+    hi = jnp.full(q.shape[0], n, dtype=jnp.int32)
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
     return lo
 
 
