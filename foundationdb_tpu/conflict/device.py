@@ -80,11 +80,7 @@ def _gc_kernel(ks, vs, off):
     return ks, jnp.maximum(vs - off, 0)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cap", "n_txn", "n_read", "n_write"),
-)
-def _resolve_kernel(
+def resolve_core(
     ks,  # uint32[CAP, W] sorted boundaries
     vs,  # int32[CAP] gap version offsets
     rb, re_,  # uint32[R, W] read range begin/end (sentinel rows = padding)
@@ -96,6 +92,9 @@ def _resolve_kernel(
     commit_off,  # int32 scalar: commit version offset for the whole batch
     *, cap: int, n_txn: int, n_read: int, n_write: int,
 ):
+    """Pure kernel body — jitted directly for the single-partition path and
+    called inside shard_map for the multi-resolver path (parallel/sharded.py),
+    where each device runs it on its own key partition's clipped ranges."""
     B, R, Wn = n_txn, n_read, n_write
 
     # ---- phase 1: history conflicts -------------------------------------
@@ -195,12 +194,73 @@ def _resolve_kernel(
     return verdict, new_ks, new_vs, new_count
 
 
+_resolve_kernel = functools.partial(
+    jax.jit, static_argnames=("cap", "n_txn", "n_read", "n_write")
+)(resolve_core)
+
+
 def _bucket(n: int, lo: int = 16) -> int:
     """Round up to a power of two to bound jit recompiles."""
     b = lo
     while b < n:
         b *= 2
     return b
+
+
+def pack_batch(txns, oldest: int, offset, max_key_bytes: int):
+    """Marshal a TxInfo batch into padded device tensors.
+
+    Shared by the single-partition and mesh-sharded conflict sets so their
+    TxInfo→tensor encodings cannot drift (verdict parity depends on it).
+    `offset` maps an absolute version to the state's int32 offset.
+    Returns (rbv, rev, rtv, wbv, wev, wtv, snap, active, bucketed_B).
+    """
+    B = len(txns)
+    W = keymod.num_words(max_key_bytes)
+    enc = functools.partial(keymod.encode_keys, max_key_bytes=max_key_bytes)
+    active = np.zeros(B, dtype=bool)
+    snap = np.zeros(B, dtype=np.int32)
+    rb_k: list[bytes] = []
+    re_k: list[bytes] = []
+    r_tx: list[int] = []
+    wb_k: list[bytes] = []
+    we_k: list[bytes] = []
+    w_tx: list[int] = []
+    for t, tx in enumerate(txns):
+        if tx.read_snapshot < oldest:
+            continue  # TOO_OLD, decided at add time (SkipList.cpp:985)
+        active[t] = True
+        snap[t] = offset(tx.read_snapshot)
+        for b, e in tx.read_ranges:
+            if b < e:
+                rb_k.append(b)
+                re_k.append(e)
+                r_tx.append(t)
+        for b, e in tx.write_ranges:
+            if b < e:
+                wb_k.append(b)
+                we_k.append(e)
+                w_tx.append(t)
+
+    Bp, R, Wn = _bucket(B), _bucket(len(r_tx)), _bucket(len(w_tx))
+
+    def pad(bk, ek, tx, n):
+        out_b = np.full((n, W), _SENT_WORD, dtype=np.uint32)
+        out_e = np.full((n, W), _SENT_WORD, dtype=np.uint32)
+        out_t = np.full(n, -1, dtype=np.int32)
+        if bk:
+            out_b[: len(bk)] = enc(bk)
+            out_e[: len(ek)] = enc(ek)
+            out_t[: len(tx)] = tx
+        return out_b, out_e, out_t
+
+    rbv, rev, rtv = pad(rb_k, re_k, r_tx, R)
+    wbv, wev, wtv = pad(wb_k, we_k, w_tx, Wn)
+    snap_p = np.zeros(Bp, dtype=np.int32)
+    snap_p[:B] = snap
+    active_p = np.zeros(Bp, dtype=bool)
+    active_p[:B] = active
+    return rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp
 
 
 class DeviceConflictSet(ConflictSet):
@@ -273,51 +333,10 @@ class DeviceConflictSet(ConflictSet):
             self._last_commit = commit_version
             return []
 
-        enc = functools.partial(keymod.encode_keys, max_key_bytes=self._max_key_bytes)
-        active = np.zeros(B, dtype=bool)
-        snap = np.zeros(B, dtype=np.int32)
-        rb_keys: list[bytes] = []
-        re_keys: list[bytes] = []
-        r_tx: list[int] = []
-        wb_keys: list[bytes] = []
-        we_keys: list[bytes] = []
-        w_tx: list[int] = []
-        for t, tx in enumerate(txns):
-            if tx.read_snapshot < self._oldest:
-                continue  # TOO_OLD, decided at add time (SkipList.cpp:985)
-            active[t] = True
-            snap[t] = self._offset(tx.read_snapshot)
-            for b, e in tx.read_ranges:
-                if b < e:
-                    rb_keys.append(b)
-                    re_keys.append(e)
-                    r_tx.append(t)
-            for b, e in tx.write_ranges:
-                if b < e:
-                    wb_keys.append(b)
-                    we_keys.append(e)
-                    w_tx.append(t)
-
-        Bp = _bucket(B)
-        R, Wn = _bucket(len(r_tx)), _bucket(len(w_tx))
-        W = self._W
-
-        def pad_ranges(bk, ek, tx, n):
-            out_b = np.full((n, W), _SENT_WORD, dtype=np.uint32)
-            out_e = np.full((n, W), _SENT_WORD, dtype=np.uint32)
-            out_t = np.full(n, -1, dtype=np.int32)
-            if bk:
-                out_b[: len(bk)] = enc(bk)
-                out_e[: len(ek)] = enc(ek)
-                out_t[: len(tx)] = tx
-            return out_b, out_e, out_t
-
-        rbv, rev, rtv = pad_ranges(rb_keys, re_keys, r_tx, R)
-        wbv, wev, wtv = pad_ranges(wb_keys, we_keys, w_tx, Wn)
-        snap_p = np.zeros(Bp, dtype=np.int32)
-        snap_p[:B] = snap
-        active_p = np.zeros(Bp, dtype=bool)
-        active_p[:B] = active
+        rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
+            txns, self._oldest, self._offset, self._max_key_bytes
+        )
+        R, Wn = rbv.shape[0], wbv.shape[0]
 
         while True:
             pre_ks, pre_vs, pre_count = self._ks, self._vs, self._count
