@@ -1,0 +1,213 @@
+"""Headline benchmark: device OCC conflict kernel vs native CPU skip list.
+
+North star (BASELINE.json): conflict-checks/s at 64K live write ranges with
+abort-set parity.  The stream mimics the reference's skipListTest shape
+(fdbserver/SkipList.cpp:1412-1502: batches of transactions with point-ish
+16-byte-key ranges) at steady state inside an MVCC window:
+
+  * history pre-populated to ~64K live write ranges (untimed)
+  * timed: batches of TXNS_PER_BATCH txns, each 2 point reads + 1 point
+    write, keys uniform over a pool, snapshots uniform in the window
+  * both backends consume pre-packed arrays (the proxy->resolver wire format
+    is packed tensors, so marshalling is not what's being compared)
+  * verdict parity asserted batch-by-batch
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is device checks/s and vs_baseline is the speedup over the native CPU skip
+list on this host.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TXNS_PER_BATCH = 4096
+READS_PER_TXN = 2
+TIMED_BATCHES = 16
+PREFILL_BATCHES = 16  # 16 * 4096 point writes ≈ 64K live ranges
+KEY_BYTES = 16  # reference benchmark key width (performance.rst:14)
+MAX_KEY_BYTES = 20  # holds the 17-byte end key of [k, k+\x00)
+KEY_POOL = 1 << 20
+WINDOW = PREFILL_BATCHES + TIMED_BATCHES + 2  # no GC mid-run: window covers it
+CAP = 1 << 18
+SEED = 20260729
+
+
+def gen_pool(rng):
+    return rng.integers(0, 256, size=(KEY_POOL, KEY_BYTES), dtype=np.uint8)
+
+
+def gen_batch(rng, pool, version):
+    """One batch as index arrays: reads[B, READS], writes[B], snaps[B]."""
+    b = TXNS_PER_BATCH
+    return dict(
+        version=version,
+        reads=rng.integers(0, KEY_POOL, size=(b, READS_PER_TXN)),
+        writes=rng.integers(0, KEY_POOL, size=(b,)),
+        snaps=np.maximum(version - 1 - rng.integers(0, WINDOW // 2, size=(b,)), 0).astype(np.int64),
+    )
+
+
+# ---------------- device packing (uint32 word lanes, keys.py layout) --------
+
+
+def device_pack(pool_words, batch, bucket):
+    """Build resolve_arrays inputs from index arrays, fully vectorized."""
+    b = TXNS_PER_BATCH
+    n_read, n_write = b * READS_PER_TXN, b
+    R, Wn = bucket(2 * n_read) // 2, bucket(n_write)
+    R = max(R, n_read)
+    W = pool_words.shape[1]  # data words + length lane
+
+    def keyed(idx, is_end):
+        k = pool_words[idx.ravel()]
+        if is_end:  # [k, k + b"\x00"): same words, length 17
+            k = k.copy()
+            k[:, -1] = KEY_BYTES + 1
+        return k
+
+    rbv = np.full((R, W), 0xFFFFFFFF, dtype=np.uint32)
+    rev = np.full((R, W), 0xFFFFFFFF, dtype=np.uint32)
+    rtv = np.full(R, -1, dtype=np.int32)
+    rbv[:n_read] = keyed(batch["reads"], False)
+    rev[:n_read] = keyed(batch["reads"], True)
+    rtv[:n_read] = np.repeat(np.arange(b, dtype=np.int32), READS_PER_TXN)
+
+    wbv = np.full((Wn, W), 0xFFFFFFFF, dtype=np.uint32)
+    wev = np.full((Wn, W), 0xFFFFFFFF, dtype=np.uint32)
+    wtv = np.full(Wn, -1, dtype=np.int32)
+    wbv[:n_write] = keyed(batch["writes"], False)
+    wev[:n_write] = keyed(batch["writes"], True)
+    wtv[:n_write] = np.arange(b, dtype=np.int32)
+
+    Bp = bucket(b)
+    snap = np.zeros(Bp, dtype=np.int32)
+    snap[:b] = batch["snaps"]
+    active = np.zeros(Bp, dtype=bool)
+    active[:b] = True
+    return rbv, rev, rtv, wbv, wev, wtv, snap, active
+
+
+def pool_to_words(pool):
+    """uint8[P, 16] -> uint32[P, words+1] in the keys.py lane layout."""
+    from foundationdb_tpu import keys as keymod
+
+    return keymod.encode_fixed(pool, MAX_KEY_BYTES)
+
+
+# ---------------- native packing (byte stream + offsets) --------------------
+
+
+def native_pack(pool, batch):
+    """C-ABI arrays: per txn, reads (b,e)* then write (b,e); e = k+\\x00."""
+    b = TXNS_PER_BATCH
+    keys_per_txn = 2 * (READS_PER_TXN + 1)
+    lens = np.tile(
+        np.array([KEY_BYTES, KEY_BYTES + 1] * (READS_PER_TXN + 1), dtype=np.int64),
+        b,
+    )
+    offsets = np.zeros(b * keys_per_txn + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = np.zeros(offsets[-1], dtype=np.uint8)
+    # txn t occupies a fixed-size slab; fill via strided views
+    slab = KEY_BYTES * keys_per_txn + (READS_PER_TXN + 1)  # ends carry +1 byte
+    view = buf.reshape(b, slab)
+    pos = 0
+    for r in range(READS_PER_TXN):
+        k = pool[batch["reads"][:, r]]
+        view[:, pos : pos + KEY_BYTES] = k
+        pos += KEY_BYTES
+        view[:, pos : pos + KEY_BYTES] = k
+        pos += KEY_BYTES + 1  # trailing \x00 already zero
+    k = pool[batch["writes"]]
+    view[:, pos : pos + KEY_BYTES] = k
+    pos += KEY_BYTES
+    view[:, pos : pos + KEY_BYTES] = k
+    return (
+        batch["snaps"],
+        np.full(b, READS_PER_TXN, dtype=np.int32),
+        np.ones(b, dtype=np.int32),
+        buf,
+        offsets,
+    )
+
+
+def main() -> None:
+    import jax
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet, _bucket
+    from foundationdb_tpu.conflict.native import NativeConflictSet
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(SEED)
+    pool = gen_pool(rng)
+    pool_words = pool_to_words(pool)
+
+    versions = iter(range(1, 10_000))
+    prefill = [gen_batch(rng, pool, next(versions)) for _ in range(PREFILL_BATCHES)]
+    timed = [gen_batch(rng, pool, next(versions)) for _ in range(TIMED_BATCHES)]
+
+    total_checks = TIMED_BATCHES * TXNS_PER_BATCH * (READS_PER_TXN + 1)
+
+    # ---------------- native baseline ----------------
+    nat = NativeConflictSet()
+    for b in prefill:
+        nat.resolve_packed(b["version"], *native_pack(pool, b))
+    packed_nat = [(b["version"], native_pack(pool, b)) for b in timed]
+    t0 = time.perf_counter()
+    nat_verdicts = [nat.resolve_packed(v, *args) for v, args in packed_nat]
+    native_s = time.perf_counter() - t0
+    live_ranges = nat.node_count // 2
+    print(
+        f"[bench] native: {native_s * 1e3:.1f} ms for {total_checks} checks "
+        f"({total_checks / native_s / 1e6:.2f} M checks/s), "
+        f"~{live_ranges} live ranges at timing start",
+        file=sys.stderr,
+    )
+    nat.close()
+
+    # ---------------- device ----------------
+    dev = DeviceConflictSet(max_key_bytes=MAX_KEY_BYTES, capacity=CAP)
+    for b in prefill:
+        dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
+    packed_dev = [(b["version"], device_pack(pool_words, b, _bucket)) for b in timed]
+    # (prefill already compiled the kernel: identical static shapes)
+    t0 = time.perf_counter()
+    dev_verdicts = [dev.resolve_arrays(v, *args) for v, args in packed_dev]
+    device_s = time.perf_counter() - t0
+    print(
+        f"[bench] device[{backend}]: {device_s * 1e3:.1f} ms "
+        f"({total_checks / device_s / 1e6:.2f} M checks/s)",
+        file=sys.stderr,
+    )
+
+    # ---------------- parity ----------------
+    mismatches = 0
+    for i, (nv, dv) in enumerate(zip(nat_verdicts, dev_verdicts)):
+        if not np.array_equal(np.asarray(nv), np.asarray(dv)[: len(nv)]):
+            mismatches += 1
+            bad = np.nonzero(np.asarray(nv) != np.asarray(dv)[: len(nv)])[0][:5]
+            print(f"[bench] PARITY MISMATCH batch {i} txns {bad}", file=sys.stderr)
+    if mismatches:
+        raise SystemExit(f"abort-set parity FAILED in {mismatches} batches")
+    print("[bench] abort-set parity OK", file=sys.stderr)
+
+    value = total_checks / device_s
+    print(
+        json.dumps(
+            {
+                "metric": f"occ_conflict_checks_per_sec_{backend}_64k_live_ranges",
+                "value": round(value, 1),
+                "unit": "checks/s",
+                "vs_baseline": round(native_s / device_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -336,8 +336,23 @@ class DeviceConflictSet(ConflictSet):
         rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
             txns, self._oldest, self._offset, self._max_key_bytes
         )
-        R, Wn = rbv.shape[0], wbv.shape[0]
+        codes = self.resolve_arrays(
+            commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p
+        )
+        return [Verdict(int(c)) for c in codes[:B]]
 
+    def resolve_arrays(
+        self, commit_version: int, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p
+    ) -> np.ndarray:
+        """Packed fast path: pre-encoded/padded arrays (see pack_batch for the
+        layout; snap_p already offset against this set's base).  This is the
+        form the resolver role feeds the device — batches arrive packed from
+        the proxy, the TxInfo path above is the convenience wrapper."""
+        if commit_version <= self._last_commit:
+            raise ValueError(
+                f"commit_version {commit_version} not after last batch {self._last_commit}"
+            )
+        Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
         while True:
             pre_ks, pre_vs, pre_count = self._ks, self._vs, self._count
             verdict, new_ks, new_vs, new_count = _resolve_kernel(
@@ -358,9 +373,7 @@ class DeviceConflictSet(ConflictSet):
                 max(self._cap * 2, _bucket(new_count)),
                 np.asarray(pre_ks), np.asarray(pre_vs), pre_count,
             )
-
-        codes = np.asarray(verdict)[:B]
-        return [Verdict(int(c)) for c in codes]
+        return np.asarray(verdict)
 
     def remove_before(self, version: int) -> None:
         if version <= self._oldest:

@@ -1,0 +1,48 @@
+"""Default native (C++ skip list) conflict backend: lazy build + plugin load.
+
+The CPU baseline implementation (native/conflictset.cpp) compiled on first
+use and loaded through the plugin seam (plugin.py).  This is the performance
+bar the device kernel is benchmarked against — the stand-in for the
+reference's fdbserver/SkipList.cpp running on a host core.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import threading
+
+from .plugin import ConflictPlugin
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_LIB = _NATIVE_DIR / "libfdbtpu_conflict.so"
+_lock = threading.Lock()
+_plugin: ConflictPlugin | None = None
+
+
+def build_native(force: bool = False) -> pathlib.Path:
+    src = _NATIVE_DIR / "conflictset.cpp"
+    with _lock:
+        if force or not _LIB.exists() or _LIB.stat().st_mtime < src.stat().st_mtime:
+            proc = subprocess.run(
+                ["make", "-s", "-C", str(_NATIVE_DIR)],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native conflict backend build failed:\n{proc.stderr}"
+                )
+    return _LIB
+
+
+def native_plugin() -> ConflictPlugin:
+    global _plugin
+    if _plugin is None:
+        _plugin = ConflictPlugin(str(build_native()))
+    return _plugin
+
+
+def NativeConflictSet(oldest_version: int = 0):
+    """Factory matching the other backends' constructors."""
+    return native_plugin().create(oldest_version)

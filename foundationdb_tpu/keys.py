@@ -79,6 +79,32 @@ def encode_keys(keys: list[bytes], max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -
     return out
 
 
+def encode_fixed(
+    key_bytes: np.ndarray, max_key_bytes: int = DEFAULT_MAX_KEY_BYTES
+) -> np.ndarray:
+    """Encode uint8[n, L] equal-length keys -> uint32[n, num_words] lanes.
+
+    Vectorized matrix form of encode_keys for callers that already hold keys
+    as a byte matrix (benchmarks, packed proxy batches).  Single source of
+    truth for the lane layout lives here next to encode_keys.
+    """
+    kw = num_words(max_key_bytes) - 1
+    n, L = key_bytes.shape
+    if L > max_key_bytes:
+        raise KeyTooLongError(f"{L}-byte keys exceed {max_key_bytes}")
+    out = np.zeros((n, kw + 1), dtype=np.uint32)
+    padded = np.zeros((n, 4 * kw), dtype=np.uint8)
+    padded[:, :L] = key_bytes
+    out[:, :kw] = (
+        (padded[:, 0::4].astype(np.uint32) << 24)
+        | (padded[:, 1::4].astype(np.uint32) << 16)
+        | (padded[:, 2::4].astype(np.uint32) << 8)
+        | padded[:, 3::4].astype(np.uint32)
+    )
+    out[:, kw] = L
+    return out
+
+
 def decode_key(enc: np.ndarray) -> bytes:
     """Inverse of encode_keys for a single encoded key."""
     kw = enc.shape[-1] - 1

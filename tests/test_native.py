@@ -1,0 +1,91 @@
+"""Native C++ skip-list backend: parity vs oracle, GC, plugin ABI."""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.conflict.api import TxInfo, Verdict
+from foundationdb_tpu.conflict.native import NativeConflictSet, native_plugin
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+
+
+def test_plugin_loads():
+    assert native_plugin().backend_name == "skiplist-cpp"
+
+
+def test_basic_semantics():
+    cs = NativeConflictSet()
+    assert cs.resolve_batch(10, [TxInfo(5, [], [(b"a", b"b")])]) == [Verdict.COMMITTED]
+    got = cs.resolve_batch(
+        20,
+        [
+            TxInfo(5, [(b"a", b"a\x00")], []),          # sees write @10 -> conflict
+            TxInfo(10, [(b"a", b"a\x00")], [(b"c", b"d")]),  # commits
+            TxInfo(10, [(b"c", b"c\x00")], []),          # intra-batch conflict
+            TxInfo(10, [(b"x", b"y")], []),              # commits
+        ],
+    )
+    assert got == [Verdict.CONFLICT, Verdict.COMMITTED, Verdict.CONFLICT, Verdict.COMMITTED]
+    cs.remove_before(15)
+    got = cs.resolve_batch(30, [TxInfo(12, [], []), TxInfo(16, [(b"zz", b"zzz")], [])])
+    assert got == [Verdict.TOO_OLD, Verdict.COMMITTED]
+    cs.close()
+
+
+def test_version_monotonicity_enforced():
+    cs = NativeConflictSet()
+    cs.resolve_batch(10, [TxInfo(0, [], [])])
+    with pytest.raises(ValueError):
+        cs.resolve_batch(10, [TxInfo(0, [], [])])
+    cs.close()
+
+
+def _random_key(rng, alpha=5, maxlen=5):
+    return bytes(rng.randrange(alpha) for _ in range(rng.randrange(1, maxlen)))
+
+
+def _random_range(rng):
+    a, b = _random_key(rng), _random_key(rng)
+    return (a, a + b"\x00") if a == b else (min(a, b), max(a, b))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_randomized_parity_vs_oracle(seed):
+    rng = random.Random(seed)
+    nat, orc = NativeConflictSet(), OracleConflictSet()
+    version = 0
+    for i in range(120):
+        version += rng.randrange(1, 4)
+        txns = [
+            TxInfo(
+                rng.randrange(max(version - 8, 0), version),
+                [_random_range(rng) for _ in range(rng.randrange(0, 4))],
+                [_random_range(rng) for _ in range(rng.randrange(0, 4))],
+            )
+            for _ in range(rng.randrange(1, 10))
+        ]
+        vn = nat.resolve_batch(version, txns)
+        vo = orc.resolve_batch(version, txns)
+        assert vn == vo, f"seed {seed} batch {i} @v{version}: {vn} != {vo}"
+        if i % 9 == 8:
+            floor = max(version - 6, 0)
+            nat.remove_before(floor)
+            orc.remove_before(floor)
+    nat.close()
+
+
+def test_gc_keeps_node_count_bounded():
+    rng = random.Random(9)
+    cs = NativeConflictSet()
+    version = 0
+    peaks = []
+    for i in range(200):
+        version += 1
+        txns = [TxInfo(version - 1, [], [_random_range(rng)]) for _ in range(8)]
+        cs.resolve_batch(version, txns)
+        cs.remove_before(max(version - 5, 0))
+        peaks.append(cs.node_count)
+    # the whole key alphabet is tiny; after GC the step function must stay
+    # near the alphabet size rather than growing with batches
+    assert max(peaks[100:]) < 2000
+    cs.close()
