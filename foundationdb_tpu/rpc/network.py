@@ -1,0 +1,191 @@
+"""Simulated network fabric — the Sim2 analog (fdbrpc/sim2.actor.cpp:714).
+
+The reference's deepest architectural property is that the transport is a
+seam: Net2 (real TCP) and Sim2 (simulated, deterministic) implement the same
+INetwork, so whole clusters run in one seeded process.  This module is that
+simulated world for the Python control plane: `SimNetwork` owns simulated
+processes, delivers endpoint-addressed messages with seeded latency, and
+injects faults — clogging (sim2 SimClogging :108, clogPair :1477),
+partitions, process kills/reboots (fdbrpc/simulator.h:148-153).
+
+Messages are deep-copied at send time: a simulated process can never share
+mutable state with a peer, the same isolation the wire gives the reference.
+
+The RPC vocabulary (RequestStream/ReplyPromise, fdbrpc/fdbrpc.h:217) lives
+in rpc/stream.py on top of this fabric; roles only see that typed layer, so
+a future real-TCP fabric slots in under them unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable
+
+from ..runtime.core import (
+    BrokenPromise,
+    DeterministicRandom,
+    EventLoop,
+    Future,
+    Promise,
+    TaskPriority,
+)
+from ..runtime.trace import TraceCollector
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class NetworkAddress:
+    ip: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """(address, token): the reference's routing pair (FlowTransport.h:34)."""
+
+    address: NetworkAddress
+    token: str
+
+
+class SimProcess:
+    """A simulated process: endpoint table + lifecycle (ISimulator::ProcessInfo)."""
+
+    def __init__(self, net: "SimNetwork", address: NetworkAddress, name: str) -> None:
+        self.net = net
+        self.address = address
+        self.name = name
+        self.alive = True
+        self.reboots = 0
+        self._endpoints: dict[str, Callable[[Any], None]] = {}
+        self.on_death: list[Promise] = []
+
+    # -- endpoints ---------------------------------------------------------
+    def register(self, token: str, handler: Callable[[Any], None]) -> Endpoint:
+        self._endpoints[token] = handler
+        return Endpoint(self.address, token)
+
+    def unregister(self, token: str) -> None:
+        self._endpoints.pop(token, None)
+
+    def new_token(self) -> str:
+        return self.net.rng.random_unique_id()
+
+    # -- lifecycle ---------------------------------------------------------
+    def _deliver(self, token: str, payload: Any) -> None:
+        if not self.alive:
+            return
+        handler = self._endpoints.get(token)
+        if handler is not None:
+            handler(payload)
+        # unknown token: dropped, like the reference's unknown-endpoint path
+
+    def kill(self) -> None:
+        """Hard kill: endpoints vanish, in-flight replies break."""
+        self.alive = False
+        self._endpoints.clear()
+        deaths, self.on_death = self.on_death, []
+        for p in deaths:
+            if not p.future.done():
+                p.send(None)
+
+    def reboot(self) -> None:
+        """Kill then come back empty: roles must re-register (the worker
+        restores its roles on reboot — fdbserver/worker.actor.cpp:577)."""
+        self.kill()
+        self.alive = True
+        self.reboots += 1
+
+
+class SimNetwork:
+    """Deterministic message fabric over an EventLoop.
+
+    Latency: seeded uniform in [min_latency, max_latency).  Faults:
+      clog_pair(a, b, t)    delay a->b messages until now+t
+      partition(a, b)       drop a<->b messages until healed
+      kill/reboot           via SimProcess
+    Delivery order between a pair is preserved (FIFO per (src, dst) like a
+    TCP connection): each pair's messages are chained behind the previous
+    delivery time.
+    """
+
+    def __init__(
+        self,
+        loop: EventLoop,
+        rng: DeterministicRandom,
+        trace: TraceCollector | None = None,
+        min_latency: float = 0.0001,
+        max_latency: float = 0.002,
+    ) -> None:
+        self.loop = loop
+        self.rng = rng.split()
+        self.trace = trace or TraceCollector(clock=loop.now)
+        self.min_latency = min_latency
+        self.max_latency = max_latency
+        self.processes: dict[NetworkAddress, SimProcess] = {}
+        self._clogged_until: dict[tuple[NetworkAddress, NetworkAddress], float] = {}
+        self._partitioned: set[frozenset[NetworkAddress]] = set()
+        self._pair_clock: dict[tuple[NetworkAddress, NetworkAddress], float] = {}
+        self.messages_sent = 0
+        self.messages_dropped = 0
+
+    # -- topology ----------------------------------------------------------
+    def create_process(self, name: str, ip: str | None = None, port: int = 4500) -> SimProcess:
+        if ip is None:
+            ip = f"1.0.0.{len(self.processes) + 1}"
+        addr = NetworkAddress(ip, port)
+        if addr in self.processes:
+            raise ValueError(f"address {addr} in use")
+        proc = SimProcess(self, addr, name)
+        self.processes[addr] = proc
+        return proc
+
+    # -- faults ------------------------------------------------------------
+    def clog_pair(self, a: NetworkAddress, b: NetworkAddress, seconds: float) -> None:
+        until = self.loop.now() + seconds
+        self._clogged_until[(a, b)] = max(self._clogged_until.get((a, b), 0), until)
+        self._clogged_until[(b, a)] = max(self._clogged_until.get((b, a), 0), until)
+        self.trace.trace("ClogPair", A=str(a), B=str(b), Until=until)
+
+    def partition(self, a: NetworkAddress, b: NetworkAddress) -> None:
+        self._partitioned.add(frozenset((a, b)))
+        self.trace.trace("Partition", A=str(a), B=str(b))
+
+    def heal_partition(self, a: NetworkAddress, b: NetworkAddress) -> None:
+        self._partitioned.discard(frozenset((a, b)))
+        self.trace.trace("HealPartition", A=str(a), B=str(b))
+
+    def heal_all(self) -> None:
+        self._partitioned.clear()
+        self._clogged_until.clear()
+
+    # -- transport ---------------------------------------------------------
+    def send(self, src: NetworkAddress, endpoint: Endpoint, payload: Any) -> None:
+        """Fire-and-forget delivery with simulated latency; payload deep-
+        copied (serialization boundary)."""
+        self.messages_sent += 1
+        dst = endpoint.address
+        if frozenset((src, dst)) in self._partitioned:
+            self.messages_dropped += 1
+            return
+        latency = self.min_latency + self.rng.random() * (self.max_latency - self.min_latency)
+        when = self.loop.now() + latency
+        clog = self._clogged_until.get((src, dst), 0.0)
+        if clog > when:
+            when = clog + latency
+        # FIFO per (src, dst): never deliver before the previous message
+        prev = self._pair_clock.get((src, dst), 0.0)
+        when = max(when, prev)
+        self._pair_clock[(src, dst)] = when
+        msg = copy.deepcopy(payload)
+
+        def deliver() -> None:
+            proc = self.processes.get(dst)
+            if proc is None or not proc.alive:
+                self.messages_dropped += 1
+                return
+            proc._deliver(endpoint.token, msg)
+
+        self.loop._at(when, TaskPriority.DEFAULT_ENDPOINT, deliver)

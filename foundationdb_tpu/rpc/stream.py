@@ -1,0 +1,147 @@
+"""Typed RPC: RequestStream / ReplyPromise over the network fabric.
+
+The reference's RPC is promises that travel the wire (fdbrpc/fdbrpc.h:217):
+a request carries an embedded ReplyPromise token; whoever holds the request
+can fire the reply back to the caller's endpoint.  Same shape here:
+
+  server:  rs = RequestStream(process, "wlt:commit")
+           req = await rs.next()          # ReceivedRequest
+           req.reply(result)              # or req.reply_error(exc)
+
+  client:  ref = RequestStreamRef(net, my_process, rs.endpoint)
+           result = await ref.get_reply(payload)
+
+Reply routing is token-addressed back to the caller (networksender analog).
+A killed/rebooted server silently drops state; callers protect themselves
+with `get_reply(payload, timeout=...)` plus the failure monitor — identical
+division of labor to the reference (fdbrpc/FailureMonitor.h).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ..runtime.combinators import timeout_error
+from ..runtime.core import Future, FutureStream, Promise, TimedOut
+from .network import Endpoint, NetworkAddress, SimNetwork, SimProcess
+
+
+@dataclasses.dataclass
+class RpcMessage:
+    """Wire envelope: payload + optional reply endpoint."""
+
+    payload: Any
+    reply_to: Endpoint | None = None
+
+
+@dataclasses.dataclass
+class RpcError:
+    """Wire form of an exception reply."""
+
+    error: Exception
+
+
+class ReplyPromise:
+    """Client-side reply slot with its own endpoint token (the promise that
+    'travels' — its token does, and replies route back to it)."""
+
+    def __init__(self, process: SimProcess) -> None:
+        self._process = process
+        self._promise = Promise()
+        self._token = "rp:" + process.new_token()
+        process.register(self._token, self._on_reply)
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return Endpoint(self._process.address, self._token)
+
+    @property
+    def future(self) -> Future:
+        return self._promise.future
+
+    def _on_reply(self, payload: Any) -> None:
+        self._process.unregister(self._token)
+        if self._promise.future.done():
+            return
+        if isinstance(payload, RpcError):
+            self._promise.fail(payload.error)
+        else:
+            self._promise.send(payload)
+
+    def dispose(self) -> None:
+        """Unregister without a reply (abandoned RPC)."""
+        self._process.unregister(self._token)
+
+
+class ReceivedRequest:
+    """Server-side view of one request: payload + reply capability."""
+
+    __slots__ = ("payload", "_reply_to", "_process", "replied")
+
+    def __init__(self, payload: Any, reply_to: Endpoint | None, process: SimProcess) -> None:
+        self.payload = payload
+        self._reply_to = reply_to
+        self._process = process
+        self.replied = False
+
+    def reply(self, value: Any = None) -> None:
+        self.replied = True
+        if self._reply_to is not None and self._process.alive:
+            self._process.net.send(self._process.address, self._reply_to, value)
+
+    def reply_error(self, err: Exception) -> None:
+        self.replied = True
+        if self._reply_to is not None and self._process.alive:
+            self._process.net.send(self._process.address, self._reply_to, RpcError(err))
+
+
+class RequestStream:
+    """Server-side stream of typed requests at a (usually well-known) token."""
+
+    def __init__(self, process: SimProcess, token: str | None = None) -> None:
+        self._process = process
+        self._token = token or ("rs:" + process.new_token())
+        self.requests = FutureStream()
+        process.register(self._token, self._on_message)
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return Endpoint(self._process.address, self._token)
+
+    def _on_message(self, msg: RpcMessage) -> None:
+        self.requests.send(ReceivedRequest(msg.payload, msg.reply_to, self._process))
+
+    def next(self) -> Future:
+        """Future of the next ReceivedRequest."""
+        return self.requests.pop()
+
+    def close(self) -> None:
+        self._process.unregister(self._token)
+        self.requests.close()
+
+
+class RequestStreamRef:
+    """Client-side handle to a remote RequestStream."""
+
+    def __init__(self, net: SimNetwork, process: SimProcess, endpoint: Endpoint) -> None:
+        self._net = net
+        self._process = process
+        self.endpoint = endpoint
+
+    def send(self, payload: Any) -> None:
+        """One-way, at-most-once (FlowTransport unreliable send)."""
+        self._net.send(self._process.address, self.endpoint, RpcMessage(payload))
+
+    def get_reply(self, payload: Any, timeout: float | None = None) -> Future:
+        rp = ReplyPromise(self._process)
+        self._net.send(
+            self._process.address, self.endpoint, RpcMessage(payload, rp.endpoint)
+        )
+        if timeout is None:
+            return rp.future
+        out = timeout_error(self._net.loop, rp.future, timeout)
+        # on timeout the reply will never be consumed: drop the endpoint so
+        # abandoned RPCs don't leak entries in the process endpoint table
+        out.add_done_callback(lambda _f: rp.dispose())
+        return out

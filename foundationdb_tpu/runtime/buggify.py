@@ -1,0 +1,43 @@
+"""Fault-injection hooks — BUGGIFY (flow/flow.h:65, flow/FaultInjection.h).
+
+In simulation, `buggify()` fires rare branches at random so seldom-taken
+paths get exercised; in production it is always False.  Each call site is
+independently enabled per run (the reference's per-SBVar state,
+flow/flow.cpp:189-214): an enabled site fires with `fire_prob` each time.
+"""
+
+from __future__ import annotations
+
+from .core import DeterministicRandom
+
+_state: dict[str, bool] = {}
+_rng: DeterministicRandom | None = None
+_enable_prob = 0.25
+_fire_prob = 0.25
+
+
+def enable(rng: DeterministicRandom, enable_prob: float = 0.25, fire_prob: float = 0.25) -> None:
+    global _rng, _enable_prob, _fire_prob
+    _rng = rng.split()
+    _enable_prob = enable_prob
+    _fire_prob = fire_prob
+    _state.clear()
+
+
+def disable() -> None:
+    global _rng
+    _rng = None
+    _state.clear()
+
+
+def is_enabled() -> bool:
+    return _rng is not None
+
+
+def buggify(site: str) -> bool:
+    """True rarely, only in simulation.  `site` identifies the call site."""
+    if _rng is None:
+        return False
+    if site not in _state:
+        _state[site] = _rng.coinflip(_enable_prob)
+    return _state[site] and _rng.coinflip(_fire_prob)

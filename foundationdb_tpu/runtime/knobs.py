@@ -1,0 +1,66 @@
+"""Typed tunables registry — the knobs system (flow/Knobs.h:37-48).
+
+Defaults here; simulation may randomize (the reference's
+Knobs(randomize=true), fdbserver/Knobs.cpp:33) and anything is overridable
+by name, the `--knob_NAME=value` path."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Knobs:
+    """A bag of typed knobs.  Subclasses declare defaults in __init__ via
+    self.init(name, value, randomize=fn) and users override by attribute or
+    set_knob(name, string_value)."""
+
+    def __init__(self) -> None:
+        self._defs: dict[str, type] = {}
+
+    def init(self, name: str, value: Any) -> None:
+        self._defs[name] = type(value)
+        setattr(self, name, value)
+
+    def set_knob(self, name: str, value: str) -> None:
+        if name not in self._defs:
+            raise KeyError(f"no such knob: {name}")
+        ty = self._defs[name]
+        setattr(self, name, ty(value) if ty is not bool else value in ("1", "true", "True"))
+
+    def names(self) -> list[str]:
+        return sorted(self._defs)
+
+
+class CoreKnobs(Knobs):
+    def __init__(self, randomize=None) -> None:
+        super().__init__()
+        r = randomize  # DeterministicRandom or None
+        # MVCC window: versions/sec * seconds (reference VERSIONS_PER_SECOND
+        # 1e6 and MAX_WRITE_TRANSACTION_LIFE 5.0, fdbserver/Knobs.cpp:30-34;
+        # simulation sometimes shrinks the window to 1s to stress TooOld)
+        self.init("VERSIONS_PER_SECOND", 1_000_000)
+        life = 5.0 if r is None or not r.coinflip(0.25) else 1.0
+        self.init("MAX_WRITE_TRANSACTION_LIFE", life)
+        self.init("MAX_READ_TRANSACTION_LIFE", life)
+        # proxy commit batching (reference COMMIT_TRANSACTION_BATCH_INTERVAL_*)
+        self.init("COMMIT_BATCH_INTERVAL_MIN", 0.0005)
+        self.init("COMMIT_BATCH_INTERVAL_MAX", 0.010)
+        self.init("COMMIT_BATCH_MAX_COUNT", 32768)
+        # grv batching
+        self.init("GRV_BATCH_INTERVAL", 0.0005)
+        # resolver
+        self.init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 30)
+        self.init("SAMPLE_OFFSET_PER_KEY", 100)
+        # storage
+        self.init("STORAGE_DURABILITY_LAG", 0.05)
+        self.init("DESIRED_TEAM_SIZE", 3)
+        # failure detection
+        self.init("FAILURE_TIMEOUT", 1.0 if r is None else 0.5 + r.random())
+        self.init("HEARTBEAT_INTERVAL", 0.2)
+        # ratekeeper
+        self.init("TARGET_QUEUE_BYTES", 1 << 27)
+        self.init("RATEKEEPER_UPDATE_INTERVAL", 0.25)
+
+    @property
+    def mvcc_window_versions(self) -> int:
+        return int(self.VERSIONS_PER_SECOND * self.MAX_WRITE_TRANSACTION_LIFE)

@@ -1,0 +1,78 @@
+"""Structured trace events + metrics — the TraceEvent system (flow/Trace.h:137).
+
+Events are dicts with severity/type/fields, collected per-process by a
+TraceCollector: in tests/simulation they stay in memory for assertions; in
+production they stream to JSONL files (the reference rolls XML files).
+`track_latest` retains the newest event per key — the transport the status
+subsystem scrapes (fdbserver/Status.actor.cpp:1698 reads trackLatest
+snapshots).  Counters mirror flow/Stats.h:57 CounterCollection.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, TextIO
+
+
+SEV_DEBUG, SEV_INFO, SEV_WARN, SEV_WARN_ALWAYS, SEV_ERROR = 5, 10, 20, 30, 40
+
+
+class TraceCollector:
+    def __init__(self, clock: Callable[[], float] | None = None,
+                 sink: TextIO | None = None, keep: int = 50000) -> None:
+        self._clock = clock or (lambda: 0.0)
+        self._sink = sink
+        self._keep = keep
+        self.events: list[dict[str, Any]] = []
+        self.latest: dict[str, dict[str, Any]] = {}
+        self._suppressed: dict[str, int] = {}
+
+    def trace(self, event_type: str, severity: int = SEV_INFO,
+              track_latest: str | None = None, **fields: Any) -> dict[str, Any]:
+        ev = {"Type": event_type, "Severity": severity, "Time": self._clock(), **fields}
+        if len(self.events) < self._keep:
+            self.events.append(ev)
+        else:
+            self._suppressed[event_type] = self._suppressed.get(event_type, 0) + 1
+        if track_latest is not None:
+            self.latest[track_latest] = ev
+        if self._sink is not None:
+            json.dump(ev, self._sink, default=str)
+            self._sink.write("\n")
+        return ev
+
+    def find(self, event_type: str) -> list[dict[str, Any]]:
+        return [e for e in self.events if e["Type"] == event_type]
+
+    def count(self, event_type: str) -> int:
+        return len(self.find(event_type)) + self._suppressed.get(event_type, 0)
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, collection: "CounterCollection | None" = None) -> None:
+        self.name = name
+        self.value = 0
+        if collection is not None:
+            collection.add(self)
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+    __iadd__ = None  # use .add()
+
+
+class CounterCollection:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.counters: list[Counter] = []
+
+    def add(self, c: Counter) -> None:
+        self.counters.append(c)
+
+    def counter(self, name: str) -> Counter:
+        return Counter(name, self)
+
+    def snapshot(self) -> dict[str, int]:
+        return {c.name: c.value for c in self.counters}

@@ -1,0 +1,172 @@
+"""Sim network + typed RPC: delivery, isolation, faults, determinism."""
+
+import dataclasses
+
+import pytest
+
+from foundationdb_tpu.rpc.network import SimNetwork
+from foundationdb_tpu.rpc.stream import RequestStream, RequestStreamRef
+from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop, TimedOut
+
+
+@dataclasses.dataclass
+class Echo:
+    text: str
+    tags: list
+
+
+def make_world(seed=1):
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(seed))
+    return loop, net
+
+
+def test_request_reply_roundtrip():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+
+    async def serve():
+        req = await rs.next()
+        req.reply(req.payload.text.upper())
+
+    loop.spawn(serve())
+    fut = ref.get_reply(Echo("hello", []))
+    assert loop.run_until(fut) == "HELLO"
+    assert loop.now() > 0  # latency was simulated
+
+
+def test_payload_isolation_deepcopy():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+    sent = Echo("x", tags=[1])
+
+    async def serve():
+        req = await rs.next()
+        req.payload.tags.append(99)  # mutating the server copy...
+        req.reply(req.payload.tags)
+
+    loop.spawn(serve())
+    got = loop.run_until(ref.get_reply(sent))
+    assert got == [1, 99]
+    assert sent.tags == [1]  # ...never touches the client's object
+
+
+def test_error_reply():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:boom")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+
+    async def serve():
+        req = await rs.next()
+        req.reply_error(ValueError("nope"))
+
+    loop.spawn(serve())
+    with pytest.raises(ValueError):
+        loop.run_until(ref.get_reply(Echo("x", [])))
+
+
+def test_dead_server_drops_and_timeout_fires():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+    server.kill()
+    fut = ref.get_reply(Echo("x", []), timeout=1.0)
+    with pytest.raises(TimedOut):
+        loop.run_until(fut)
+    assert net.messages_dropped == 1
+
+
+def test_partition_and_heal():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+
+    async def serve_forever():
+        while True:
+            req = await rs.next()
+            req.reply("pong")
+
+    loop.spawn(serve_forever())
+    net.partition(server.address, client.address)
+    with pytest.raises(TimedOut):
+        loop.run_until(ref.get_reply("ping", timeout=0.5))
+    net.heal_partition(server.address, client.address)
+    assert loop.run_until(ref.get_reply("ping", timeout=0.5)) == "pong"
+
+
+def test_clog_delays_but_delivers():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+
+    async def serve():
+        req = await rs.next()
+        req.reply("pong")
+
+    loop.spawn(serve())
+    net.clog_pair(server.address, client.address, 3.0)
+    fut = ref.get_reply("ping")
+    assert loop.run_until(fut) == "pong"
+    assert loop.now() > 3.0
+
+
+def test_fifo_per_pair():
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:q")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+    got = []
+
+    async def serve():
+        for _ in range(20):
+            req = await rs.next()
+            got.append(req.payload)
+
+    t = loop.spawn(serve())
+    for i in range(20):
+        ref.send(i)
+    loop.run_until(t)
+    assert got == list(range(20))
+
+
+def test_network_determinism():
+    def run(seed):
+        loop, net = make_world(seed)
+        server = net.create_process("server")
+        client = net.create_process("client")
+        rs = RequestStream(server, "wlt:echo")
+        ref = RequestStreamRef(net, client, rs.endpoint)
+        times = []
+
+        async def serve():
+            while True:
+                req = await rs.next()
+                req.reply(req.payload * 2)
+
+        loop.spawn(serve())
+
+        async def drive():
+            for i in range(10):
+                v = await ref.get_reply(i)
+                times.append((v, round(loop.now(), 9)))
+
+        loop.run_until(loop.spawn(drive()))
+        return times
+
+    assert run(5) == run(5)
+    assert run(5) != run(6)

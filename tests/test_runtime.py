@@ -1,0 +1,206 @@
+"""Core runtime: futures, deterministic loop, combinators — the dsltest
+analog (reference fdbrpc/dsltest.actor.cpp exercises flow primitives)."""
+
+import pytest
+
+from foundationdb_tpu.runtime import buggify
+from foundationdb_tpu.runtime.combinators import (
+    AsyncTrigger,
+    AsyncVar,
+    quorum,
+    timeout_error,
+    wait_all,
+    wait_any,
+)
+from foundationdb_tpu.runtime.core import (
+    ActorCancelled,
+    BrokenPromise,
+    DeterministicRandom,
+    EventLoop,
+    Future,
+    FutureStream,
+    Promise,
+    TaskPriority,
+    TimedOut,
+)
+
+
+def test_promise_future_basics():
+    p = Promise()
+    assert not p.future.done()
+    p.send(42)
+    assert p.future.done() and p.future.result() == 42
+    with pytest.raises(RuntimeError):
+        p.send(43)  # single assignment
+
+    p2 = Promise()
+    p2.fail(ValueError("boom"))
+    with pytest.raises(ValueError):
+        p2.future.result()
+
+
+def test_broken_promise():
+    p = Promise()
+    f = p.future
+    del p
+    assert isinstance(f.exception(), BrokenPromise)
+
+
+def test_loop_runs_coroutines_in_virtual_time():
+    loop = EventLoop()
+    order = []
+
+    async def worker(name, d):
+        await loop.delay(d)
+        order.append((name, loop.now()))
+        return name
+
+    t1 = loop.spawn(worker("a", 2.0))
+    t2 = loop.spawn(worker("b", 1.0))
+    loop.run_until(wait_all([t1, t2]))
+    assert order == [("b", 1.0), ("a", 2.0)]
+    assert loop.now() == 2.0  # virtual clock jumped, no wall time spent
+
+
+def test_priority_ordering_at_same_time():
+    loop = EventLoop()
+    order = []
+    loop._at(1.0, TaskPriority.LOW, lambda: order.append("low"))
+    loop._at(1.0, TaskPriority.PROXY_COMMIT, lambda: order.append("commit"))
+    loop._at(1.0, TaskPriority.STORAGE_SERVER, lambda: order.append("ss"))
+    loop.drain()
+    assert order == ["commit", "ss", "low"]
+
+
+def test_determinism_same_seed_same_schedule():
+    def run(seed):
+        loop = EventLoop()
+        rng = DeterministicRandom(seed)
+        log = []
+
+        async def chatter(i):
+            for _ in range(5):
+                await loop.delay(rng.random() * 0.1)
+                log.append((i, round(loop.now(), 9)))
+
+        tasks = [loop.spawn(chatter(i)) for i in range(4)]
+        loop.run_until(wait_all(tasks))
+        return log
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+
+
+def test_cancellation_throws_actor_cancelled():
+    loop = EventLoop()
+    witness = []
+
+    async def stubborn():
+        try:
+            await loop.delay(100.0)
+        except ActorCancelled:
+            witness.append("cancelled")
+            raise
+
+    t = loop.spawn(stubborn())
+    loop.run_one()  # start it
+    t.cancel()
+    loop.drain()
+    assert witness == ["cancelled"]
+    assert isinstance(t.exception(), ActorCancelled)
+
+
+def test_future_stream():
+    loop = EventLoop()
+    s = FutureStream()
+    got = []
+
+    async def consumer():
+        for _ in range(3):
+            got.append(await s.pop())
+
+    t = loop.spawn(consumer())
+    s.send(1)
+    s.send(2)
+    loop.drain()
+    s.send(3)
+    loop.run_until(t)
+    assert got == [1, 2, 3]
+
+
+def test_wait_any_and_timeout():
+    loop = EventLoop()
+
+    async def main():
+        i, v = await wait_any([loop.delay(5.0), loop.delay(1.0)])
+        assert i == 1
+        with pytest.raises(TimedOut):
+            await timeout_error(loop, loop.delay(10.0), 2.0)
+        return "done"
+
+    assert loop.run_until(loop.spawn(main())) == "done"
+
+
+def test_quorum():
+    loop = EventLoop()
+    ps = [Promise() for _ in range(5)]
+    q = quorum([p.future for p in ps], 3)
+    ps[0].send(None)
+    ps[1].send(None)
+    assert not q.done()
+    ps[4].send(None)
+    assert q.done() and q.exception() is None
+
+    ps2 = [Promise() for _ in range(3)]
+    q2 = quorum([p.future for p in ps2], 3)
+    ps2[1].fail(ValueError("x"))
+    assert q2.done() and isinstance(q2.exception(), ValueError)
+
+
+def test_async_var_and_trigger():
+    loop = EventLoop()
+    av = AsyncVar(1)
+    f = av.on_change()
+    av.set(1)  # no change, no fire
+    assert not f.done()
+    av.set(2)
+    assert f.done() and f.result() == 2
+
+    trig = AsyncTrigger()
+    f1, f2 = trig.on_trigger(), trig.on_trigger()
+    trig.trigger()
+    assert f1.done() and f2.done()
+    assert not trig.on_trigger().done()  # new waiter needs a new trigger
+
+
+def test_buggify_deterministic_and_off_outside_sim():
+    assert not buggify.buggify("site1")  # disabled by default
+    buggify.enable(DeterministicRandom(3), enable_prob=1.0, fire_prob=1.0)
+    assert buggify.buggify("site1")
+    buggify.disable()
+    assert not buggify.buggify("site1")
+
+
+def test_knobs():
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+    k = CoreKnobs()
+    assert k.VERSIONS_PER_SECOND == 1_000_000
+    k.set_knob("VERSIONS_PER_SECOND", "500")
+    assert k.VERSIONS_PER_SECOND == 500
+    with pytest.raises(KeyError):
+        k.set_knob("NO_SUCH", "1")
+    assert k.mvcc_window_versions == int(500 * k.MAX_WRITE_TRANSACTION_LIFE)
+
+
+def test_trace_collector():
+    from foundationdb_tpu.runtime.trace import SEV_WARN, TraceCollector
+
+    clock = {"t": 0.0}
+    tc = TraceCollector(clock=lambda: clock["t"])
+    tc.trace("CommitBatch", Txns=5)
+    clock["t"] = 1.5
+    tc.trace("MasterRecoveryState", severity=SEV_WARN, track_latest="master", State="locking")
+    assert tc.count("CommitBatch") == 1
+    assert tc.latest["master"]["State"] == "locking"
+    assert tc.find("MasterRecoveryState")[0]["Time"] == 1.5
