@@ -1,0 +1,121 @@
+"""Read-your-writes layer (fdbclient/ReadYourWrites.actor.cpp).
+
+Wraps a Transaction with a WriteMap (fdbclient/WriteMap.h:119): reads see
+the transaction's own uncommitted writes merged over snapshot reads, the
+semantics every FDB client API exposes by default.  Atomic ops buffered
+here fold into literal values when the key has a known local value, else
+they pass through for the storage server to apply (the reference's
+unreadable-write handling).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from ..keys import key_after
+from ..roles.types import MutationType, apply_atomic
+from .transaction import Database, Transaction
+
+
+_CLEARED = object()
+
+
+class WriteMap:
+    """Buffered writes: point writes + cleared ranges, mergeable over
+    snapshot data for range reads."""
+
+    def __init__(self) -> None:
+        self._writes: dict[bytes, object] = {}   # key -> value | _CLEARED
+        self._clears: list[tuple[bytes, bytes]] = []
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._writes[key] = value
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        for k in list(self._writes):
+            if begin <= k < end:
+                del self._writes[k]
+        self._clears.append((begin, end))
+
+    def lookup(self, key: bytes):
+        """Returns value, _CLEARED, or None (unknown locally)."""
+        if key in self._writes:
+            return self._writes[key]
+        for b, e in self._clears:
+            if b <= key < e:
+                return _CLEARED
+        return None
+
+    def overlay_range(self, data: list[tuple[bytes, bytes]], begin: bytes, end: bytes,
+                      limit: int) -> list[tuple[bytes, bytes]]:
+        merged = {k: v for k, v in data}
+        for b, e in self._clears:
+            for k in list(merged):
+                if b <= k < e:
+                    del merged[k]
+        for k, v in self._writes.items():
+            if begin <= k < end:
+                if v is _CLEARED:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+        return sorted(merged.items())[:limit]
+
+
+class ReadYourWritesTransaction:
+    def __init__(self, db: Database) -> None:
+        self._tr = db.create_transaction()
+        self._wm = WriteMap()
+
+    # -- reads (merged) ------------------------------------------------------
+    async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        local = self._wm.lookup(key)
+        if local is _CLEARED:
+            return None
+        if local is not None:
+            return local  # served from the write map: no storage read at all
+        return await self._tr.get(key, snapshot=snapshot)
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 10000,
+                        snapshot: bool = False) -> list[tuple[bytes, bytes]]:
+        data = await self._tr.get_range(begin, end, limit=limit, snapshot=snapshot)
+        return self._wm.overlay_range(data, begin, end, limit)
+
+    # -- writes (buffered in both layers) ------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        self._wm.set(key, value)
+        self._tr.set(key, value)
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._wm.clear_range(begin, end)
+        self._tr.clear_range(begin, end)
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
+        local = self._wm.lookup(key)
+        if local is not None and local is not _CLEARED:
+            # fold into a literal so later reads see it (RYWIterator folding)
+            new = apply_atomic(op, local, operand)
+            self.set(key, new)
+        else:
+            self._tr.atomic_op(op, key, operand)
+            # subsequent local reads of this key are undefined until commit
+            # (reference: unreadable ranges); keep it absent from the WriteMap
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_read_conflict_range(begin, end)
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_write_conflict_range(begin, end)
+
+    async def get_read_version(self):
+        return await self._tr.get_read_version()
+
+    async def commit(self):
+        return await self._tr.commit()
+
+    @property
+    def committed_version(self):
+        return self._tr.committed_version

@@ -1,0 +1,158 @@
+"""Client transaction API — the NativeAPI analog (fdbclient/NativeAPI.actor.cpp).
+
+`Database` is the connection handle; `Transaction` implements the FDB
+transaction model: snapshot reads at a GRV-acquired read version
+(getReadVersion :2821), buffered writes, conflict ranges accumulated per
+read/write, OCC commit via the proxy (tryCommit :2412), and the retry loop
+(`Database.run`, the `fdb.transactional` analog: on_error backoff + full
+retry on NotCommitted / TransactionTooOld).
+
+Reads route to storage servers by key partition (the client's location
+cache, getKeyLocation_internal :1085 — here a static map handed out by the
+cluster; invalidation/refresh arrives with data distribution).
+"""
+
+from __future__ import annotations
+
+from ..roles.proxy import KeyPartitionMap
+from ..roles.types import (
+    CommitReply,
+    CommitResult,
+    CommitTransactionRequest,
+    FutureVersion,
+    GetKeyValuesRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+    Mutation,
+    MutationType,
+    NotCommitted,
+    TransactionTooOld,
+    Version,
+)
+from ..rpc.stream import RequestStreamRef
+from ..runtime.core import DeterministicRandom, EventLoop
+from ..keys import key_after
+
+
+class Database:
+    def __init__(
+        self,
+        loop: EventLoop,
+        grv_ref: RequestStreamRef,
+        commit_ref: RequestStreamRef,
+        storage_map: KeyPartitionMap,  # members: {"getvalue": ref, "getkeyvalues": ref}
+        rng: DeterministicRandom,
+    ) -> None:
+        self.loop = loop
+        self._grv = grv_ref
+        self._commit = commit_ref
+        self._smap = storage_map
+        self._rng = rng.split()
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self)
+
+    async def run(self, fn, max_retries: int = 50):
+        """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
+        errors back off and start over with a fresh read version."""
+        backoff = 0.01
+        for _attempt in range(max_retries):
+            tr = self.create_transaction()
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except (NotCommitted, TransactionTooOld, FutureVersion):
+                await self.loop.delay(backoff * (0.5 + self._rng.random()))
+                backoff = min(backoff * 2, 1.0)
+        raise NotCommitted(f"transaction failed after {max_retries} retries")
+
+
+class Transaction:
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self._read_version: Version | None = None
+        self._mutations: list[Mutation] = []
+        self._read_ranges: list[tuple[bytes, bytes]] = []
+        self._write_ranges: list[tuple[bytes, bytes]] = []
+        self.committed_version: Version | None = None
+
+    # -- read version -------------------------------------------------------
+    async def get_read_version(self) -> Version:
+        if self._read_version is None:
+            reply = await self.db._grv.get_reply(GetReadVersionRequest(), timeout=5.0)
+            self._read_version = reply.version
+        return self._read_version
+
+    # -- reads --------------------------------------------------------------
+    async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        v = await self.get_read_version()
+        refs = self.db._smap.member_for_key(key)
+        reply = await refs["getvalue"].get_reply(GetValueRequest(key, v), timeout=5.0)
+        if not snapshot:
+            self._read_ranges.append((key, key_after(key)))
+        return reply.value
+
+    async def get_range(
+        self, begin: bytes, end: bytes, limit: int = 10000, snapshot: bool = False
+    ) -> list[tuple[bytes, bytes]]:
+        v = await self.get_read_version()
+        out: list[tuple[bytes, bytes]] = []
+        smap = self.db._smap
+        # walk shards left to right (the client iterates locations :1228)
+        for idx in range(len(smap.members)):
+            clip = smap.clip_to_member(idx, begin, end)
+            if clip is None:
+                continue
+            b, e = clip
+            reply = await smap.members[idx]["getkeyvalues"].get_reply(
+                GetKeyValuesRequest(b, e, v, limit - len(out)), timeout=5.0
+            )
+            out.extend(reply.data)
+            if len(out) >= limit:
+                break
+        if not snapshot:
+            self._read_ranges.append((begin, end))
+        return out[:limit]
+
+    # -- writes -------------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        self._mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self._write_ranges.append((key, key_after(key)))
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self._write_ranges.append((begin, end))
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
+        self._mutations.append(Mutation(op, key, operand))
+        self._write_ranges.append((key, key_after(key)))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._read_ranges.append((begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._write_ranges.append((begin, end))
+
+    # -- commit -------------------------------------------------------------
+    async def commit(self) -> Version:
+        if not self._mutations and not self._write_ranges:
+            self.committed_version = self._read_version or 0
+            return self.committed_version  # read-only: nothing to commit
+        v = await self.get_read_version()
+        req = CommitTransactionRequest(
+            read_snapshot=v,
+            read_conflict_ranges=list(self._read_ranges),
+            write_conflict_ranges=list(self._write_ranges),
+            mutations=list(self._mutations),
+        )
+        reply: CommitReply = await self.db._commit.get_reply(req, timeout=5.0)
+        if reply.result == CommitResult.COMMITTED:
+            self.committed_version = reply.version
+            return reply.version
+        if reply.result == CommitResult.TRANSACTION_TOO_OLD:
+            raise TransactionTooOld()
+        raise NotCommitted()

@@ -1,0 +1,147 @@
+"""Cluster assembly: wire the write pipeline + storage into one simulated
+cluster (the SimulatedCluster analog, fdbserver/SimulatedCluster.actor.cpp).
+
+`SimCluster` builds the minimum end-to-end system of SURVEY §7 step 5:
+sequencer + N resolvers (pluggable conflict backend) + M TLogs + storage
+servers per key shard + a commit proxy, all as simulated processes on one
+deterministic EventLoop.  `database()` hands back a client handle.
+
+The control plane (coordinators, recruitment, recovery) layers on top in
+control/; this module is also what benchmarks and workloads drive.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .client.transaction import Database
+from .conflict.api import ConflictSet
+from .conflict.oracle import OracleConflictSet
+from .roles.proxy import CommitProxy, KeyPartitionMap
+from .roles.resolver import Resolver
+from .roles.sequencer import Sequencer
+from .roles.storage import MemoryKeyValueStore, StorageServer
+from .roles.tlog import TLog
+from .rpc.network import SimNetwork
+from .rpc.stream import RequestStreamRef
+from .runtime.core import DeterministicRandom, EventLoop
+from .runtime.knobs import CoreKnobs
+from .runtime.trace import TraceCollector
+
+
+class SimCluster:
+    def __init__(
+        self,
+        seed: int = 0,
+        n_resolvers: int = 1,
+        n_storage_shards: int = 1,
+        n_tlogs: int = 1,
+        conflict_backend: Callable[[], ConflictSet] | None = None,
+        knobs: CoreKnobs | None = None,
+        resolver_splits: list[bytes] | None = None,
+        storage_splits: list[bytes] | None = None,
+    ) -> None:
+        self.loop = EventLoop()
+        self.rng = DeterministicRandom(seed)
+        self.knobs = knobs or CoreKnobs()
+        self.trace = TraceCollector(clock=self.loop.now)
+        self.net = SimNetwork(self.loop, self.rng, self.trace)
+        make_cs = conflict_backend or OracleConflictSet
+
+        # default splits: evenly spread single-byte prefixes
+        def default_splits(n: int) -> list[bytes]:
+            return [bytes([256 * i // n]) for i in range(1, n)]
+
+        self.resolver_splits = (
+            resolver_splits if resolver_splits is not None else default_splits(n_resolvers)
+        )
+        self.storage_splits = (
+            storage_splits if storage_splits is not None else default_splits(n_storage_shards)
+        )
+
+        # -- processes & roles ------------------------------------------------
+        self.seq_proc = self.net.create_process("sequencer")
+        self.sequencer = Sequencer(self.seq_proc, self.loop, self.knobs)
+
+        self.tlogs: list[TLog] = []
+        for i in range(n_tlogs):
+            p = self.net.create_process(f"tlog-{i}")
+            self.tlogs.append(TLog(p, self.loop))
+
+        self.resolvers: list[Resolver] = []
+        for i in range(n_resolvers):
+            p = self.net.create_process(f"resolver-{i}")
+            self.resolvers.append(Resolver(p, self.loop, self.knobs, make_cs()))
+
+        # storage shards: tag "ss-i" owned by storage server i, pulling from
+        # tlog i % n_tlogs
+        self.storage: list[StorageServer] = []
+        for i in range(n_storage_shards):
+            p = self.net.create_process(f"storage-{i}")
+            tlog = self.tlogs[i % n_tlogs]
+            ss = StorageServer(
+                p,
+                self.loop,
+                self.knobs,
+                tlog_peek_ref=self._ref(p, tlog.peek_stream.endpoint),
+                tlog_pop_ref=self._ref(p, tlog.pop_stream.endpoint),
+                tag=f"ss-{i}",
+                store=MemoryKeyValueStore(),
+            )
+            self.storage.append(ss)
+
+        self.proxy_proc = self.net.create_process("proxy")
+        storage_tag_map = KeyPartitionMap(
+            self.storage_splits, [f"ss-{i}" for i in range(n_storage_shards)]
+        )
+        self.proxy = CommitProxy(
+            self.proxy_proc,
+            self.loop,
+            self.knobs,
+            sequencer_ref=self._ref(self.proxy_proc, self.sequencer.stream.endpoint),
+            resolver_refs=[
+                self._ref(self.proxy_proc, r.stream.endpoint) for r in self.resolvers
+            ],
+            resolver_splits=self.resolver_splits,
+            tlog_refs=[
+                self._ref(self.proxy_proc, t.commit_stream.endpoint) for t in self.tlogs
+            ],
+            storage_tags=storage_tag_map,
+            tag_to_tlog={f"ss-{i}": i % n_tlogs for i in range(n_storage_shards)},
+        )
+
+        self.client_proc = self.net.create_process("client")
+
+    def _ref(self, process, endpoint) -> RequestStreamRef:
+        return RequestStreamRef(self.net, process, endpoint)
+
+    def database(self, process=None) -> Database:
+        proc = process or self.client_proc
+        storage_members = [
+            {
+                "getvalue": self._ref(proc, ss.getvalue_stream.endpoint),
+                "getkeyvalues": self._ref(proc, ss.getkv_stream.endpoint),
+            }
+            for ss in self.storage
+        ]
+        smap = KeyPartitionMap(self.storage_splits, storage_members)
+        return Database(
+            self.loop,
+            grv_ref=self._ref(proc, self.proxy.grv_stream.endpoint),
+            commit_ref=self._ref(proc, self.proxy.commit_stream.endpoint),
+            storage_map=smap,
+            rng=self.rng,
+        )
+
+    def run_until(self, fut, deadline: float | None = None):
+        return self.loop.run_until(fut, deadline)
+
+    def stop(self) -> None:
+        self.proxy.stop()
+        for r in self.resolvers:
+            r.stop()
+        for t in self.tlogs:
+            t.stop()
+        for s in self.storage:
+            s.stop()
+        self.sequencer.stop()

@@ -33,11 +33,14 @@ from typing import Sequence
 
 
 class Verdict(enum.IntEnum):
-    # Values match the reference's ConflictBatch::TransactionCommitted
-    # (fdbserver/ConflictSet.h:42-46) order: conflict, committed, too_old.
+    # Values match the reference's ConflictBatch::TransactionCommitResult
+    # (fdbserver/ConflictSet.h:36-40): Conflict=0, TooOld=1, Committed=2.
+    # The ordering is load-bearing: the proxy min-combines verdicts across
+    # resolvers, so CONFLICT < TOO_OLD < COMMITTED means "any resolver that
+    # couldn't verify (conflict or too-old) vetoes the commit".
     CONFLICT = 0
-    COMMITTED = 1
-    TOO_OLD = 2
+    TOO_OLD = 1
+    COMMITTED = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -193,7 +193,9 @@ class SkipListStepFunction {
   uint64_t rng_;
 };
 
-enum Verdict : uint8_t { kConflict = 0, kCommitted = 1, kTooOld = 2 };
+// Matches fdbserver/ConflictSet.h:36-40 TransactionCommitResult ordering
+// (min-combine across resolvers relies on it; see conflict/api.py Verdict).
+enum Verdict : uint8_t { kConflict = 0, kTooOld = 1, kCommitted = 2 };
 
 class ConflictSetImpl {
  public:

@@ -1,0 +1,90 @@
+"""Resolver role — OCC conflict detection hosting a ConflictSet backend
+(fdbserver/Resolver.actor.cpp:71 resolveBatch, :262 resolverCore).
+
+The role is a thin, totally-ordered shell around the conflict backend:
+batches carry (prev_version → version) chain links; a batch waits until the
+chain reaches its prev_version (NotifiedVersion, Resolver.actor.cpp:104-115),
+then runs the backend's batched check and replies verdicts.  MVCC GC runs
+per batch with the knob-derived window (SkipList removeBefore :1199-1206).
+
+The backend is pluggable (conflict/plugin.py seam): oracle (tests), native
+C++ skip list (CPU), device kernel (TPU/XLA — the north star), or the
+mesh-sharded device set.  Resolver state evaporates on generation change —
+recovery builds a fresh Resolver (SURVEY §5), which the master accounts for
+by seeding post-recovery resolvers with oldest = recovery version.
+"""
+
+from __future__ import annotations
+
+from ..conflict.api import ConflictSet, Verdict
+from .sequencer import NotifiedVersion
+from .types import (
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    Version,
+)
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from ..runtime.core import EventLoop, TaskPriority
+from ..runtime.knobs import CoreKnobs
+from ..runtime.trace import CounterCollection
+
+
+class Resolver:
+    WLT = "wlt:resolver"
+
+    def __init__(
+        self,
+        process: SimProcess,
+        loop: EventLoop,
+        knobs: CoreKnobs,
+        conflict_set: ConflictSet,
+        start_version: Version = 0,
+    ) -> None:
+        self.loop = loop
+        self.knobs = knobs
+        self.cs = conflict_set
+        self.version = NotifiedVersion(start_version)
+        self.stream = RequestStream(process, self.WLT)
+        self.counters = CounterCollection("Resolver")
+        self.c_batches = self.counters.counter("batches")
+        self.c_txns = self.counters.counter("txns")
+        self.c_conflicts = self.counters.counter("conflicts")
+        self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
+
+    async def _serve(self) -> None:
+        while True:
+            req = await self.stream.next()
+            # each batch resolves in its own task so later batches can queue
+            # behind the version chain without blocking the stream
+            self.loop.spawn(self._resolve_one(req), TaskPriority.RESOLVER)
+
+    async def _resolve_one(self, req) -> None:
+        r: ResolveTransactionBatchRequest = req.payload
+        await self.version.when_at_least(r.prev_version)
+        if self.version.get() >= r.version:
+            # duplicate delivery (proxy retry after timeout): the reference
+            # caches recent outcomes; we conservatively abort-all so the
+            # client retries (safe: committed=false never loses data)
+            req.reply(
+                ResolveTransactionBatchReply(
+                    committed=[int(Verdict.CONFLICT)] * len(r.transactions)
+                )
+            )
+            return
+        verdicts = self.cs.resolve_batch(r.version, r.transactions)
+        self.c_batches.add(1)
+        self.c_txns.add(len(r.transactions))
+        self.c_conflicts.add(sum(1 for v in verdicts if v == Verdict.CONFLICT))
+        # MVCC GC: versions older than the write-transaction window can no
+        # longer be checked against; raise the TooOld floor
+        window = self.knobs.mvcc_window_versions
+        if r.version > window:
+            self.cs.remove_before(r.version - window)
+        self.version.set(r.version)
+        req.reply(ResolveTransactionBatchReply(committed=[int(v) for v in verdicts]))
+
+    def stop(self) -> None:
+        self._task.cancel()
+        self.stream.close()
+        self.cs.close()

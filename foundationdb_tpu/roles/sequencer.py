@@ -1,0 +1,86 @@
+"""Sequencer — the master's commit-version authority
+(fdbserver/masterserver.actor.cpp:831 getVersion).
+
+Assigns strictly increasing commit versions, advancing with the virtual
+clock at VERSIONS_PER_SECOND (so a version *is* a timestamp, the property
+the MVCC window math relies on), and hands each proxy batch the
+(prev_version, version) pair that chains the global batch order — resolvers
+and TLogs process batches strictly in that chain order.
+"""
+
+from __future__ import annotations
+
+from ..roles.types import GetCommitVersionReply, GetCommitVersionRequest, Version
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from ..runtime.core import EventLoop, Future, Promise, TaskPriority
+from ..runtime.knobs import CoreKnobs
+
+
+class NotifiedVersion:
+    """Monotone version with wait-until (the Orderer/NotifiedVersion pattern,
+    fdbserver/Resolver.actor.cpp:56): consumers await when_at_least(v) and
+    are resumed in version order when set() advances."""
+
+    def __init__(self, start: Version = 0) -> None:
+        self._value = start
+        self._waiters: list[tuple[Version, Promise]] = []
+
+    def get(self) -> Version:
+        return self._value
+
+    def set(self, v: Version) -> None:
+        if v < self._value:
+            raise ValueError(f"NotifiedVersion moving backwards: {v} < {self._value}")
+        self._value = v
+        ready = [w for w in self._waiters if w[0] <= v]
+        self._waiters = [w for w in self._waiters if w[0] > v]
+        for want, p in sorted(ready, key=lambda w: w[0]):
+            p.send(v)
+
+    def when_at_least(self, v: Version) -> Future:
+        if self._value >= v:
+            p = Promise()
+            p.send(self._value)
+            return p.future
+        p = Promise()
+        self._waiters.append((v, p))
+        return p.future
+
+
+class Sequencer:
+    """Version-assignment service; one per cluster generation."""
+
+    WLT = "wlt:sequencer"
+
+    def __init__(self, process: SimProcess, loop: EventLoop, knobs: CoreKnobs,
+                 start_version: Version = 0) -> None:
+        self.loop = loop
+        self.knobs = knobs
+        self._last_assigned: Version = start_version
+        self._prev: Version = start_version
+        self._epoch_start = loop.now()
+        self._version_at_epoch = start_version
+        self.stream = RequestStream(process, self.WLT)
+        self._task = loop.spawn(self._serve(), TaskPriority.GET_LIVE_VERSION, "sequencer")
+
+    def _next_version(self) -> Version:
+        # advance with the clock: version ≈ epoch_version + elapsed * rate
+        # (masterserver getVersion ties versions to wall time x 1e6)
+        target = self._version_at_epoch + int(
+            (self.loop.now() - self._epoch_start) * self.knobs.VERSIONS_PER_SECOND
+        )
+        return max(self._last_assigned + 1, target)
+
+    async def _serve(self) -> None:
+        while True:
+            req = await self.stream.next()
+            assert isinstance(req.payload, GetCommitVersionRequest)
+            v = self._next_version()
+            reply = GetCommitVersionReply(prev_version=self._last_assigned, version=v)
+            self._last_assigned = v
+            req.reply(reply)
+
+    def stop(self) -> None:
+        self._task.cancel()
+        self.stream.close()

@@ -1,0 +1,278 @@
+"""Storage server — MVCC reads over a pluggable KV store
+(fdbserver/storageserver.actor.cpp; VersionedMap fdbclient/VersionedMap.h).
+
+A storage server *pulls* its tag's mutations from the TLog (update :2371 via
+peek cursors), applies them to an in-memory versioned overlay, serves reads
+at any version inside the MVCC window (getValueQ :723, getKeyValues :1228),
+and continuously makes data durable in its IKeyValueStore, popping the TLog
+up to the durable version.  Commit latency never includes storage apply —
+the same asynchrony as the reference.
+
+The versioned overlay keeps, per key, the recent version chain; reads pick
+the newest entry ≤ read version.  Older versions fall out as durability
+advances (VersionedMap forgetVersionsBefore).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterable
+
+from .sequencer import NotifiedVersion
+from .types import (
+    FutureVersion,
+    GetKeyValuesReply,
+    GetKeyValuesRequest,
+    GetValueReply,
+    GetValueRequest,
+    Mutation,
+    MutationType,
+    TLogPeekRequest,
+    TLogPopRequest,
+    TransactionTooOld,
+    Version,
+    apply_atomic,
+)
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+from ..runtime.core import EventLoop, TaskPriority, TimedOut
+from ..runtime.knobs import CoreKnobs
+
+
+class MemoryKeyValueStore:
+    """The `memory` storage engine analog (KeyValueStoreMemory.actor.cpp:57):
+    ordered in-memory map; durable by fiat (a DiskQueue-backed version slots
+    in via the same interface)."""
+
+    def __init__(self) -> None:
+        self._keys: list[bytes] = []
+        self._data: dict[bytes, bytes] = {}
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if key not in self._data:
+            bisect.insort(self._keys, key)
+        self._data[key] = value
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for k in self._keys[lo:hi]:
+            del self._data[k]
+        del self._keys[lo:hi]
+
+    def range_read(self, begin: bytes, end: bytes, limit: int) -> list[tuple[bytes, bytes]]:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        return [(k, self._data[k]) for k in self._keys[lo : min(hi, lo + limit)]]
+
+    def key_count(self) -> int:
+        return len(self._keys)
+
+
+_CLEARED = object()  # tombstone marker in version chains
+
+
+class VersionedOverlay:
+    """Per-key version chains + range-clear history over a durable base.
+
+    Read algorithm for (key, v): newest overlay entry with version <= v wins
+    (value or tombstone); else if a clear-range at version <= v covers the
+    key and is newer than durability, the base value is hidden; else base.
+    Simplification vs the reference's PTree: clears keep an explicit range
+    list inside the window (bounded by the window's mutation count).
+    """
+
+    def __init__(self) -> None:
+        self._chains: dict[bytes, list[tuple[Version, object]]] = {}
+        self._clears: list[tuple[Version, bytes, bytes]] = []  # (v, begin, end)
+        self.oldest = 0  # oldest readable version retained
+
+    def apply(self, version: Version, m: Mutation, base_get) -> None:
+        if m.type == MutationType.SET_VALUE:
+            self._chains.setdefault(m.key, []).append((version, m.value))
+        elif m.type == MutationType.CLEAR_RANGE:
+            self._clears.append((version, m.key, m.value))
+            for k in list(self._chains):
+                if m.key <= k < m.value:
+                    self._chains[k].append((version, _CLEARED))
+        else:  # atomic op: fold with the current visible value
+            old = self.get(m.key, version, base_get)
+            new = apply_atomic(m.type, old, m.value)
+            self._chains.setdefault(m.key, []).append((version, new))
+
+    def _cleared_after_base(self, key: bytes, version: Version) -> bool:
+        return any(v <= version and b <= key < e for v, b, e in self._clears)
+
+    def get(self, key: bytes, version: Version, base_get) -> bytes | None:
+        chain = self._chains.get(key)
+        if chain:
+            for v, val in reversed(chain):
+                if v <= version:
+                    return None if val is _CLEARED else val
+        if self._cleared_after_base(key, version):
+            return None
+        return base_get(key)
+
+    def overlay_keys_in(self, begin: bytes, end: bytes) -> Iterable[bytes]:
+        return (k for k in self._chains if begin <= k < end)
+
+    def forget_before(self, version: Version, base_set, base_clear) -> None:
+        """Flush entries <= version into the base and drop old history."""
+        for key, chain in list(self._chains.items()):
+            flushable = [(v, val) for v, val in chain if v <= version]
+            if flushable:
+                v, val = flushable[-1]
+                # clears newer than this set (but <= version) win over it
+                if any(
+                    cv <= version and cv >= v and b <= key < e
+                    for cv, b, e in self._clears
+                ):
+                    val = _CLEARED
+                if val is _CLEARED:
+                    base_clear(key, key + b"\x00")
+                else:
+                    base_set(key, val)
+                remaining = [(v2, val2) for v2, val2 in chain if v2 > version]
+                if remaining:
+                    self._chains[key] = remaining
+                else:
+                    del self._chains[key]
+        for cv, b, e in self._clears:
+            if cv <= version:
+                base_clear(b, e)
+        self._clears = [c for c in self._clears if c[0] > version]
+        self.oldest = max(self.oldest, version)
+
+
+class StorageServer:
+    WLT_GETVALUE = "wlt:ss_getvalue"
+    WLT_GETKEYVALUES = "wlt:ss_getkeyvalues"
+
+    def __init__(
+        self,
+        process: SimProcess,
+        loop: EventLoop,
+        knobs: CoreKnobs,
+        tlog_peek_ref: RequestStreamRef,
+        tlog_pop_ref: RequestStreamRef,
+        tag: str,
+        store: MemoryKeyValueStore | None = None,
+        start_version: Version = 0,
+    ) -> None:
+        self.loop = loop
+        self.knobs = knobs
+        self.tlog = tlog_peek_ref
+        self.tlog_pop = tlog_pop_ref
+        self.tag = tag
+        self.store = store or MemoryKeyValueStore()
+        self.overlay = VersionedOverlay()
+        self.version = NotifiedVersion(start_version)   # newest applied
+        self.durable_version = start_version
+        self._fetched = start_version
+        self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE)
+        self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES)
+        self._tasks = [
+            loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, f"ss-pull-{tag}"),
+            loop.spawn(self._serve_getvalue(), TaskPriority.STORAGE_SERVER, f"ss-gv-{tag}"),
+            loop.spawn(self._serve_getkv(), TaskPriority.STORAGE_SERVER, f"ss-gkv-{tag}"),
+            loop.spawn(self._durability(), TaskPriority.STORAGE_SERVER, f"ss-dur-{tag}"),
+        ]
+
+    # -- write path: pull from TLog -----------------------------------------
+    async def _pull(self) -> None:
+        while True:
+            try:
+                reply = await self.tlog.get_reply(
+                    TLogPeekRequest(self.tag, self._fetched + 1), timeout=1.0
+                )
+            except TimedOut:
+                # TLog down or unreachable (kill/clog/partition): back off
+                # and retry — the pull loop must survive transient faults
+                await self.loop.delay(0.1, TaskPriority.STORAGE_SERVER)
+                continue
+            for version, muts in reply.entries:
+                if version <= self.version.get():
+                    continue
+                for m in muts:
+                    self.overlay.apply(version, m, self.store.get)
+                self.version.set(version)
+                self._fetched = version
+            if reply.end_version - 1 > self.version.get():
+                # tlog knows newer versions with no data for our tag
+                self.version.set(reply.end_version - 1)
+                self._fetched = reply.end_version - 1
+            if not reply.entries:
+                await self.loop.delay(0.005, TaskPriority.STORAGE_SERVER)
+
+    async def _durability(self) -> None:
+        while True:
+            await self.loop.delay(self.knobs.STORAGE_DURABILITY_LAG, TaskPriority.STORAGE_SERVER)
+            target = self.version.get()
+            window = self.knobs.mvcc_window_versions
+            flush_to = target - window
+            if flush_to > self.durable_version:
+                self.overlay.forget_before(
+                    flush_to, self.store.set, self.store.clear_range
+                )
+                self.durable_version = flush_to
+                self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))
+
+    # -- read path ----------------------------------------------------------
+    async def _wait_version(self, version: Version) -> None:
+        if version > self.version.get():
+            # bounded wait: reads slightly ahead of applied data (future_version)
+            from ..runtime.combinators import timeout_error
+
+            try:
+                await timeout_error(self.loop, self.version.when_at_least(version), 1.0)
+            except TimedOut:
+                raise FutureVersion(f"version {version} not yet at storage")
+        if version < self.overlay.oldest:
+            raise TransactionTooOld(f"version {version} < oldest {self.overlay.oldest}")
+
+    async def _serve_getvalue(self) -> None:
+        while True:
+            req = await self.getvalue_stream.next()
+            self.loop.spawn(self._getvalue_one(req), TaskPriority.STORAGE_SERVER)
+
+    async def _getvalue_one(self, req) -> None:
+        r: GetValueRequest = req.payload
+        try:
+            await self._wait_version(r.version)
+        except (TransactionTooOld, FutureVersion) as e:
+            req.reply_error(e)
+            return
+        req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
+
+    async def _serve_getkv(self) -> None:
+        while True:
+            req = await self.getkv_stream.next()
+            self.loop.spawn(self._getkv_one(req), TaskPriority.STORAGE_SERVER)
+
+    async def _getkv_one(self, req) -> None:
+        r: GetKeyValuesRequest = req.payload
+        try:
+            await self._wait_version(r.version)
+        except (TransactionTooOld, FutureVersion) as e:
+            req.reply_error(e)
+            return
+        base = {k: v for k, v in self.store.range_read(r.begin, r.end, r.limit + 1000)}
+        keys = set(base) | set(self.overlay.overlay_keys_in(r.begin, r.end))
+        out = []
+        for k in sorted(keys):
+            val = self.overlay.get(k, r.version, self.store.get)
+            if val is not None:
+                out.append((k, val))
+            if len(out) > r.limit:
+                break
+        more = len(out) > r.limit
+        req.reply(GetKeyValuesReply(out[: r.limit], more))
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self.getvalue_stream.close()
+        self.getkv_stream.close()

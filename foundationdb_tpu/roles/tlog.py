@@ -1,0 +1,112 @@
+"""Transaction log — the durability point (fdbserver/TLogServer.actor.cpp).
+
+Receives ordered mutation batches tagged per storage server (tLogCommit
+:1169), holds version-indexed per-tag queues (LogData :284), serves
+tLogPeekMessages (:932) to storage servers and trims with tLogPop (:880).
+
+This is the memory TLog; commits ack after an (optional simulated) sync
+delay.  A DiskQueue-backed variant layers underneath via the same interface
+(storage/diskqueue.py).  Version ordering is enforced with NotifiedVersion
+exactly like the resolver: a batch whose prev_version hasn't been logged
+yet waits its turn.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from .sequencer import NotifiedVersion
+from .types import (
+    TLogCommitRequest,
+    TLogPeekReply,
+    TLogPeekRequest,
+    TLogPopRequest,
+    Version,
+)
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from ..runtime.core import EventLoop, TaskPriority
+
+
+class TLog:
+    WLT_COMMIT = "wlt:tlog_commit"
+    WLT_PEEK = "wlt:tlog_peek"
+    WLT_POP = "wlt:tlog_pop"
+
+    def __init__(self, process: SimProcess, loop: EventLoop,
+                 start_version: Version = 0, sync_delay: float = 0.0005) -> None:
+        self.loop = loop
+        self.process = process
+        self.sync_delay = sync_delay
+        self.version = NotifiedVersion(start_version)
+        # per-tag: sorted list of (version, [Mutation]); popped prefix removed
+        self._tags: dict[str, list[tuple[Version, list]]] = {}
+        self._poppable: dict[str, Version] = {}
+        self.commit_stream = RequestStream(process, self.WLT_COMMIT)
+        self.peek_stream = RequestStream(process, self.WLT_PEEK)
+        self.pop_stream = RequestStream(process, self.WLT_POP)
+        self._tasks = [
+            loop.spawn(self._serve_commit(), TaskPriority.TLOG_COMMIT, "tlog-commit"),
+            loop.spawn(self._serve_peek(), TaskPriority.TLOG_COMMIT, "tlog-peek"),
+            loop.spawn(self._serve_pop(), TaskPriority.TLOG_COMMIT, "tlog-pop"),
+        ]
+
+    # -- commit ------------------------------------------------------------
+    async def _serve_commit(self) -> None:
+        while True:
+            req = await self.commit_stream.next()
+            self.loop.spawn(self._commit_one(req), TaskPriority.TLOG_COMMIT)
+
+    async def _commit_one(self, req) -> None:
+        r: TLogCommitRequest = req.payload
+        await self.version.when_at_least(r.prev_version)
+        if self.version.get() >= r.version:
+            # duplicate push (proxy retry): already logged, ack again
+            req.reply(r.version)
+            return
+        for tag, muts in r.mutations_by_tag.items():
+            self._tags.setdefault(tag, []).append((r.version, muts))
+        if self.sync_delay:
+            await self.loop.delay(self.sync_delay, TaskPriority.TLOG_COMMIT)
+        self.version.set(r.version)
+        req.reply(r.version)
+
+    # -- peek --------------------------------------------------------------
+    async def _serve_peek(self) -> None:
+        while True:
+            req = await self.peek_stream.next()
+            r: TLogPeekRequest = req.payload
+            q = self._tags.get(r.tag, [])
+            i = bisect.bisect_left(q, r.begin_version, key=lambda e: e[0])
+            entries = q[i : i + 1000]
+            truncated = i + 1000 < len(q)
+            # on truncation, end_version must not skip unfetched entries
+            end = entries[-1][0] + 1 if truncated else self.version.get() + 1
+            req.reply(TLogPeekReply(entries=entries, end_version=end))
+
+    # -- pop ---------------------------------------------------------------
+    async def _serve_pop(self) -> None:
+        while True:
+            req = await self.pop_stream.next()
+            r: TLogPopRequest = req.payload
+            self._poppable[r.tag] = max(self._poppable.get(r.tag, 0), r.upto_version)
+            q = self._tags.get(r.tag, [])
+            i = bisect.bisect_right(q, r.upto_version, key=lambda e: e[0])
+            if i:
+                self._tags[r.tag] = q[i:]
+            req.reply(None)
+
+    @property
+    def bytes_queued(self) -> int:
+        return sum(
+            len(m.key) + len(m.value)
+            for q in self._tags.values()
+            for _v, muts in q
+            for m in muts
+        )
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for s in (self.commit_stream, self.peek_stream, self.pop_stream):
+            s.close()

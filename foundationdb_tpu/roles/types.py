@@ -1,0 +1,225 @@
+"""Transaction vocabulary + role interfaces.
+
+Mirrors the reference's wire types: MutationRef and CommitTransactionRef
+(fdbclient/CommitTransaction.h:29,89), Version = int64
+(fdbclient/FDBTypes.h:29), the role interface structs
+(fdbclient/MasterProxyInterface.h, fdbserver/ResolverInterface.h:72-85,
+fdbserver/TLogInterface.h), and the atomic-op math (fdbclient/Atomic.h).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import Sequence
+
+from ..rpc.network import Endpoint
+
+Version = int
+INVALID_VERSION = -1
+
+
+class MutationType(enum.IntEnum):
+    SET_VALUE = 0
+    CLEAR_RANGE = 1
+    ADD = 2              # little-endian integer add (Atomic.h add)
+    BIT_AND = 3
+    BIT_OR = 4
+    BIT_XOR = 5
+    APPEND_IF_FITS = 6
+    MAX_ = 7             # byte-wise max
+    MIN_ = 8
+    SET_VERSIONSTAMPED_KEY = 9
+    SET_VERSIONSTAMPED_VALUE = 10
+    BYTE_MIN = 11
+    BYTE_MAX = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutation:
+    type: MutationType
+    key: bytes           # for CLEAR_RANGE: range begin
+    value: bytes         # for CLEAR_RANGE: range end
+
+
+def apply_atomic(op: MutationType, old: bytes | None, operand: bytes) -> bytes:
+    """Atomic-op math (fdbclient/Atomic.h semantics: operands zero-extended
+    to a common length; ADD wraps little-endian)."""
+    old = old or b""
+    if op == MutationType.ADD:
+        n = len(operand)
+        if n == 0:
+            return old
+        a = int.from_bytes(old[:n].ljust(n, b"\x00"), "little")
+        b = int.from_bytes(operand, "little")
+        return ((a + b) % (1 << (8 * n))).to_bytes(n, "little")
+    n = max(len(old), len(operand))
+    a = old.ljust(n, b"\x00")
+    b = operand.ljust(n, b"\x00")
+    if op == MutationType.BIT_AND:
+        # reference semantics: AND with missing value treats old as absent ⇒ operand
+        if not old:
+            return operand
+        return bytes(x & y for x, y in zip(a, b))
+    if op == MutationType.BIT_OR:
+        return bytes(x | y for x, y in zip(a, b))
+    if op == MutationType.BIT_XOR:
+        return bytes(x ^ y for x, y in zip(a, b))
+    if op in (MutationType.MAX_, MutationType.BYTE_MAX):
+        return max(a, b) if op == MutationType.BYTE_MAX else _int_max(old, operand)
+    if op in (MutationType.MIN_, MutationType.BYTE_MIN):
+        return min(a, b) if op == MutationType.BYTE_MIN else _int_min(old, operand)
+    if op == MutationType.APPEND_IF_FITS:
+        return old + operand if len(old) + len(operand) <= 131072 else old
+    raise ValueError(f"not an atomic op: {op}")
+
+
+def _int_max(old: bytes, operand: bytes) -> bytes:
+    n = len(operand)
+    a = int.from_bytes(old[:n].ljust(n, b"\x00"), "little") if old else 0
+    b = int.from_bytes(operand, "little")
+    return max(a, b).to_bytes(n, "little") if n else b""
+
+
+def _int_min(old: bytes, operand: bytes) -> bytes:
+    n = len(operand)
+    if not old:
+        return operand  # reference: MIN with absent old stores the operand
+    a = int.from_bytes(old[:n].ljust(n, b"\x00"), "little")
+    b = int.from_bytes(operand, "little")
+    return min(a, b).to_bytes(n, "little") if n else b""
+
+
+@dataclasses.dataclass
+class CommitTransactionRequest:
+    """What a client submits (CommitTransactionRef, CommitTransaction.h:89)."""
+
+    read_snapshot: Version
+    read_conflict_ranges: list[tuple[bytes, bytes]]
+    write_conflict_ranges: list[tuple[bytes, bytes]]
+    mutations: list[Mutation]
+
+
+class CommitResult(enum.Enum):
+    COMMITTED = "committed"
+    NOT_COMMITTED = "not_committed"          # OCC conflict: retryable
+    TRANSACTION_TOO_OLD = "transaction_too_old"
+
+
+@dataclasses.dataclass
+class CommitReply:
+    result: CommitResult
+    version: Version = INVALID_VERSION
+
+
+# ---- sequencer (master version authority) --------------------------------
+
+
+@dataclasses.dataclass
+class GetCommitVersionRequest:
+    requesting_proxy: str
+
+
+@dataclasses.dataclass
+class GetCommitVersionReply:
+    prev_version: Version
+    version: Version
+
+
+# ---- resolver -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResolveTransactionBatchRequest:
+    """One proxy batch's slice for one resolver (ResolverInterface.h:85)."""
+
+    prev_version: Version
+    version: Version
+    transactions: list  # list[TxInfo] (conflict/api.py)
+
+
+@dataclasses.dataclass
+class ResolveTransactionBatchReply:
+    committed: list[int]  # Verdict per txn (ResolverInterface.h:72)
+
+
+# ---- tlog -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TLogCommitRequest:
+    prev_version: Version
+    version: Version
+    mutations_by_tag: dict[str, list[Mutation]]
+
+
+@dataclasses.dataclass
+class TLogPeekRequest:
+    tag: str
+    begin_version: Version
+
+
+@dataclasses.dataclass
+class TLogPeekReply:
+    entries: list[tuple[Version, list[Mutation]]]
+    end_version: Version    # caller may peek again from here
+
+
+@dataclasses.dataclass
+class TLogPopRequest:
+    tag: str
+    upto_version: Version
+
+
+# ---- GRV ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GetReadVersionRequest:
+    pass
+
+
+@dataclasses.dataclass
+class GetReadVersionReply:
+    version: Version
+
+
+# ---- storage --------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GetValueRequest:
+    key: bytes
+    version: Version
+
+
+@dataclasses.dataclass
+class GetValueReply:
+    value: bytes | None
+
+
+@dataclasses.dataclass
+class GetKeyValuesRequest:
+    begin: bytes
+    end: bytes
+    version: Version
+    limit: int = 10000
+
+
+@dataclasses.dataclass
+class GetKeyValuesReply:
+    data: list[tuple[bytes, bytes]]
+    more: bool
+
+
+class TransactionTooOld(Exception):
+    pass
+
+
+class FutureVersion(Exception):
+    pass
+
+
+class NotCommitted(Exception):
+    pass

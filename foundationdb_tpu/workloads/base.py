@@ -1,0 +1,53 @@
+"""Workload API — the TestWorkload pattern
+(fdbserver/workloads/workloads.h:55-74: description/setup/start/check,
+composed concurrently by the tester and checked after a quiet period).
+
+A workload runs against a SimCluster's database; `run_workloads` composes
+several concurrently (the reference composes e.g. Cycle + RandomClogging +
+Attrition in one spec), waits for all `start` phases, then runs every
+`check` — the post-condition gate."""
+
+from __future__ import annotations
+
+from ..cluster import SimCluster
+from ..runtime.combinators import wait_all
+from ..runtime.core import DeterministicRandom
+
+
+class Workload:
+    description = "workload"
+
+    async def setup(self, cluster: SimCluster, rng: DeterministicRandom) -> None:
+        pass
+
+    async def start(self, cluster: SimCluster, rng: DeterministicRandom) -> None:
+        raise NotImplementedError
+
+    async def check(self, cluster: SimCluster, rng: DeterministicRandom) -> bool:
+        return True
+
+    def metrics(self) -> dict:
+        return {}
+
+
+def run_workloads(
+    cluster: SimCluster, workloads: list[Workload], deadline: float = 300.0
+) -> dict:
+    """Run setup → concurrent starts → checks; returns merged metrics.
+    Raises AssertionError if any check fails."""
+    rng = cluster.rng.split()
+
+    async def driver():
+        for w in workloads:
+            await w.setup(cluster, rng.split())
+        await wait_all(
+            [cluster.loop.spawn(w.start(cluster, rng.split())) for w in workloads]
+        )
+        results = {}
+        for w in workloads:
+            ok = await w.check(cluster, rng.split())
+            assert ok, f"workload check failed: {w.description}"
+            results[w.description] = w.metrics()
+        return results
+
+    return cluster.run_until(cluster.loop.spawn(driver()), deadline)

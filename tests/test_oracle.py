@@ -93,3 +93,13 @@ def test_step_function_vs_brute_force():
             qb, qe = bytes([qi]), bytes([qj])
             expect = max((brute[k] for k in universe if qb <= k < qe), default=0)
             assert sf.query_max(qb, qe) == expect, (step, qb, qe)
+
+
+def test_verdict_min_combine_ordering():
+    """The proxy min-combines verdicts across resolvers; the enum order must
+    make CONFLICT and TOO_OLD each veto COMMITTED (ConflictSet.h:36-40)."""
+    from foundationdb_tpu.conflict.api import Verdict
+
+    assert min(Verdict.TOO_OLD, Verdict.COMMITTED) == Verdict.TOO_OLD
+    assert min(Verdict.CONFLICT, Verdict.TOO_OLD) == Verdict.CONFLICT
+    assert min(Verdict.CONFLICT, Verdict.COMMITTED) == Verdict.CONFLICT

@@ -1,0 +1,201 @@
+"""End-to-end write pipeline: client -> proxy -> sequencer/resolvers ->
+TLogs -> storage, all under deterministic simulation (SURVEY §7 step 5)."""
+
+import pytest
+
+from foundationdb_tpu.cluster import SimCluster
+from foundationdb_tpu.roles.types import MutationType, NotCommitted
+from foundationdb_tpu.runtime.core import TimedOut
+
+
+def run(cluster, coro, deadline=60.0):
+    return cluster.run_until(cluster.loop.spawn(coro), deadline)
+
+
+def test_set_then_get():
+    c = SimCluster(seed=1)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"hello", b"world")
+        v = await tr.commit()
+        assert v > 0
+        tr2 = db.create_transaction()
+        got = await tr2.get(b"hello")
+        missing = await tr2.get(b"nothing")
+        return got, missing
+
+    got, missing = run(c, main())
+    assert got == b"world" and missing is None
+    c.stop()
+
+
+def test_occ_conflict_detected():
+    c = SimCluster(seed=2)
+    db = c.database()
+
+    async def main():
+        # tr1 and tr2 both read k then write it; the later committer must abort
+        tr1 = db.create_transaction()
+        tr2 = db.create_transaction()
+        await tr1.get(b"k")
+        await tr2.get(b"k")
+        tr1.set(b"k", b"one")
+        tr2.set(b"k", b"two")
+        await tr1.commit()
+        with pytest.raises(NotCommitted):
+            await tr2.commit()
+        # non-overlapping transaction sails through
+        tr3 = db.create_transaction()
+        await tr3.get(b"other")
+        tr3.set(b"other", b"x")
+        await tr3.commit()
+        tr4 = db.create_transaction()
+        return await tr4.get(b"k")
+
+    assert run(c, main()) == b"one"
+    c.stop()
+
+
+def test_retry_loop_resolves_contention():
+    c = SimCluster(seed=3)
+    db = c.database()
+
+    async def incr(tr):
+        cur = await tr.get(b"counter")
+        n = int(cur or b"0")
+        tr.set(b"counter", str(n + 1).encode())
+        return n + 1
+
+    async def main():
+        # 10 concurrent increments; OCC + retry must serialize them all
+        tasks = [c.loop.spawn(db.run(incr)) for _ in range(10)]
+        from foundationdb_tpu.runtime.combinators import wait_all
+
+        await wait_all(tasks)
+        tr = db.create_transaction()
+        return await tr.get(b"counter")
+
+    assert run(c, main()) == b"10"
+    c.stop()
+
+
+def test_clear_range_and_range_read():
+    c = SimCluster(seed=4, n_storage_shards=3)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        for i in range(20):
+            tr.set(b"row/%03d" % i, b"v%d" % i)
+        await tr.commit()
+
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"row/", b"row0")
+        assert len(rows) == 20
+        tr.clear_range(b"row/005", b"row/015")
+        await tr.commit()
+
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"row/", b"row0")
+        return [k for k, _ in rows]
+
+    keys = run(c, main())
+    assert keys == [b"row/%03d" % i for i in list(range(5)) + list(range(15, 20))]
+    c.stop()
+
+
+def test_atomic_add_concurrent_no_conflict():
+    c = SimCluster(seed=5)
+    db = c.database()
+
+    async def main():
+        # atomic ADD has no read conflict range: all commit without retries
+        from foundationdb_tpu.runtime.combinators import wait_all
+
+        async def add_once():
+            tr = db.create_transaction()
+            tr.atomic_op(MutationType.ADD, b"sum", (3).to_bytes(4, "little"))
+            await tr.commit()
+
+        await wait_all([c.loop.spawn(add_once()) for _ in range(8)])
+        tr = db.create_transaction()
+        raw = await tr.get(b"sum")
+        return int.from_bytes(raw, "little")
+
+    assert run(c, main()) == 24
+    c.stop()
+
+
+def test_multi_resolver_multi_shard():
+    c = SimCluster(seed=6, n_resolvers=4, n_storage_shards=4, n_tlogs=2)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        # keys spread across all 4 partitions ([0x40/0x80/0xc0] splits)
+        for b in (b"\x10aa", b"\x50bb", b"\x90cc", b"\xd0dd"):
+            tr.set(b, b"val-" + b)
+        await tr.commit()
+        tr2 = db.create_transaction()
+        vals = [await tr2.get(k) for k in (b"\x10aa", b"\x50bb", b"\x90cc", b"\xd0dd")]
+        # cross-partition conflict: reads all, writes one
+        tr3 = db.create_transaction()
+        await tr3.get_range(b"\x00", b"\xff")
+        tr4 = db.create_transaction()
+        tr4.set(b"\x90cc", b"changed")
+        await tr4.commit()
+        tr3.set(b"\x10aa", b"doomed")
+        with pytest.raises(NotCommitted):
+            await tr3.commit()
+        return vals
+
+    vals = run(c, main())
+    assert vals == [b"val-\x10aa", b"val-\x50bb", b"val-\x90cc", b"val-\xd0dd"]
+    c.stop()
+
+
+def test_read_your_future_writes_not_visible_before_commit():
+    c = SimCluster(seed=7)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"x", b"1")
+        # plain Transaction is not RYW: the read goes to storage
+        val = await tr.get(b"x")
+        await tr.commit()
+        return val
+
+    assert run(c, main()) is None
+    c.stop()
+
+
+def test_pipeline_determinism():
+    def once(seed):
+        c = SimCluster(seed=seed, n_resolvers=2, n_storage_shards=2)
+        db = c.database()
+        events = []
+
+        async def writer(i):
+            for j in range(3):
+                try:
+                    tr = db.create_transaction()
+                    await tr.get(b"shared")
+                    tr.set(b"shared", b"%d-%d" % (i, j))
+                    v = await tr.commit()
+                    events.append((i, j, v, round(c.loop.now(), 9)))
+                except NotCommitted:
+                    events.append((i, j, "abort", round(c.loop.now(), 9)))
+
+        from foundationdb_tpu.runtime.combinators import wait_all
+
+        c.run_until(
+            wait_all([c.loop.spawn(writer(i)) for i in range(3)]), 60.0
+        )
+        c.stop()
+        return events
+
+    assert once(42) == once(42)
+    assert once(42) != once(43)

@@ -1,0 +1,76 @@
+"""Read-your-writes layer semantics."""
+
+from foundationdb_tpu.client.ryw import ReadYourWritesTransaction
+from foundationdb_tpu.cluster import SimCluster
+from foundationdb_tpu.roles.types import MutationType
+
+
+def run(c, coro):
+    return c.run_until(c.loop.spawn(coro), 60.0)
+
+
+def test_ryw_sees_own_writes():
+    c = SimCluster(seed=11)
+    db = c.database()
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        tr.set(b"a", b"1")
+        assert await tr.get(b"a") == b"1"      # before commit
+        tr.clear(b"a")
+        assert await tr.get(b"a") is None
+        tr.set(b"a", b"2")
+        await tr.commit()
+        tr2 = ReadYourWritesTransaction(db)
+        return await tr2.get(b"a")
+
+    assert run(c, main()) == b"2"
+    c.stop()
+
+
+def test_ryw_range_merge():
+    c = SimCluster(seed=12)
+    db = c.database()
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        for i in range(5):
+            tr.set(b"k%d" % i, b"old")
+        await tr.commit()
+
+        tr = ReadYourWritesTransaction(db)
+        tr.set(b"k2", b"new")          # overwrite
+        tr.clear(b"k3")                # delete
+        tr.set(b"k9", b"added")        # insert
+        rows = await tr.get_range(b"k", b"l")
+        return rows
+
+    rows = run(c, main())
+    assert rows == [
+        (b"k0", b"old"),
+        (b"k1", b"old"),
+        (b"k2", b"new"),
+        (b"k4", b"old"),
+        (b"k9", b"added"),
+    ]
+    c.stop()
+
+
+def test_ryw_atomic_fold():
+    c = SimCluster(seed=13)
+    db = c.database()
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        tr.set(b"n", (10).to_bytes(4, "little"))
+        tr.atomic_op(MutationType.ADD, b"n", (5).to_bytes(4, "little"))
+        local = await tr.get(b"n")      # folded locally
+        await tr.commit()
+        tr2 = ReadYourWritesTransaction(db)
+        stored = await tr2.get(b"n")
+        return local, stored
+
+    local, stored = run(c, main())
+    assert int.from_bytes(local, "little") == 15
+    assert int.from_bytes(stored, "little") == 15
+    c.stop()

@@ -1,0 +1,66 @@
+"""Workload harness: invariant workloads against the simulated cluster,
+including the device conflict backend in the resolver (the north-star
+configuration: same cluster, conflict checks on the XLA kernel)."""
+
+import pytest
+
+from foundationdb_tpu.cluster import SimCluster
+from foundationdb_tpu.workloads.bank import BankWorkload
+from foundationdb_tpu.workloads.base import run_workloads
+from foundationdb_tpu.workloads.conflict_range import ConflictRangeWorkload
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+def test_cycle_single_resolver():
+    c = SimCluster(seed=21)
+    w = CycleWorkload(nodes=12, clients=3, txns_per_client=10)
+    metrics = run_workloads(c, [w])
+    assert metrics["Cycle"]["committed"] == 30
+    c.stop()
+
+
+def test_cycle_and_bank_composed_multi_resolver():
+    c = SimCluster(seed=22, n_resolvers=3, n_storage_shards=2, n_tlogs=2)
+    cyc = CycleWorkload(nodes=10, clients=2, txns_per_client=8)
+    bank = BankWorkload(accounts=8, clients=2, transfers_per_client=8)
+    metrics = run_workloads(c, [cyc, bank])
+    assert metrics["Cycle"]["committed"] == 16
+    assert metrics["Bank"]["committed"] == 16
+    c.stop()
+
+
+def test_conflict_range_parity():
+    c = SimCluster(seed=23, n_resolvers=2)
+    w = ConflictRangeWorkload(rounds=30)
+    metrics = run_workloads(c, [w])
+    assert metrics["ConflictRange"]["checked"] == 30
+    c.stop()
+
+
+def test_cycle_with_device_conflict_backend():
+    """The north-star wiring: resolver hosts the JAX device kernel; the
+    whole cluster sim stays deterministic on the CPU backend."""
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    c = SimCluster(
+        seed=24,
+        n_resolvers=2,
+        conflict_backend=lambda: DeviceConflictSet(capacity=1 << 12),
+    )
+    w = CycleWorkload(nodes=8, clients=2, txns_per_client=5)
+    cr = ConflictRangeWorkload(rounds=10)
+    metrics = run_workloads(c, [w, cr])
+    assert metrics["Cycle"]["committed"] == 10
+    c.stop()
+
+
+def test_workload_determinism():
+    def once():
+        c = SimCluster(seed=25, n_resolvers=2)
+        w = CycleWorkload(nodes=10, clients=3, txns_per_client=6)
+        m = run_workloads(c, [w])
+        t = c.loop.now()
+        c.stop()
+        return m, t
+
+    assert once() == once()
