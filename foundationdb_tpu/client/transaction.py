@@ -19,6 +19,7 @@ from ..roles.types import (
     CommitReply,
     CommitResult,
     CommitTransactionRequest,
+    CommitUnknownResult,
     FutureVersion,
     GetKeyValuesRequest,
     GetReadVersionRequest,
@@ -30,31 +31,60 @@ from ..roles.types import (
     Version,
 )
 from ..rpc.stream import RequestStreamRef
-from ..runtime.core import DeterministicRandom, EventLoop
+from ..runtime.core import DeterministicRandom, EventLoop, TimedOut
 from ..keys import key_after
+
+
+class ClusterView:
+    """The client's window onto the current cluster generation — the
+    MonitorLeader/cluster-file analog.  The control plane mutates these
+    attributes on recovery; every Transaction reads them per call, so
+    clients follow failovers without restarting."""
+
+    def __init__(
+        self,
+        grv_ref: RequestStreamRef,
+        commit_ref: RequestStreamRef,
+        storage_map: KeyPartitionMap,  # members: {"getvalue": ref, "getkeyvalues": ref}
+        epoch: int = 0,
+    ) -> None:
+        self.grv = grv_ref
+        self.commit = commit_ref
+        self.smap = storage_map
+        self.epoch = epoch
 
 
 class Database:
     def __init__(
         self,
         loop: EventLoop,
-        grv_ref: RequestStreamRef,
-        commit_ref: RequestStreamRef,
-        storage_map: KeyPartitionMap,  # members: {"getvalue": ref, "getkeyvalues": ref}
+        view: ClusterView,
         rng: DeterministicRandom,
     ) -> None:
         self.loop = loop
-        self._grv = grv_ref
-        self._commit = commit_ref
-        self._smap = storage_map
+        self.view = view
         self._rng = rng.split()
+
+    @property
+    def _grv(self) -> RequestStreamRef:
+        return self.view.grv
+
+    @property
+    def _commit(self) -> RequestStreamRef:
+        return self.view.commit
+
+    @property
+    def _smap(self) -> KeyPartitionMap:
+        return self.view.smap
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
     async def run(self, fn, max_retries: int = 50):
         """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
-        errors back off and start over with a fresh read version."""
+        errors back off and start over with a fresh read version.
+        CommitUnknownResult is retried too — safe for idempotent or
+        self-verifying transactions, the reference's contract."""
         backoff = 0.01
         for _attempt in range(max_retries):
             tr = self.create_transaction()
@@ -62,7 +92,13 @@ class Database:
                 result = await fn(tr)
                 await tr.commit()
                 return result
-            except (NotCommitted, TransactionTooOld, FutureVersion):
+            except (
+                NotCommitted,
+                TransactionTooOld,
+                FutureVersion,
+                CommitUnknownResult,
+                TimedOut,
+            ):
                 await self.loop.delay(backoff * (0.5 + self._rng.random()))
                 backoff = min(backoff * 2, 1.0)
         raise NotCommitted(f"transaction failed after {max_retries} retries")
@@ -149,10 +185,16 @@ class Transaction:
             write_conflict_ranges=list(self._write_ranges),
             mutations=list(self._mutations),
         )
-        reply: CommitReply = await self.db._commit.get_reply(req, timeout=5.0)
+        try:
+            reply: CommitReply = await self.db._commit.get_reply(req, timeout=5.0)
+        except TimedOut:
+            # proxy unreachable: the commit may have happened
+            raise CommitUnknownResult()
         if reply.result == CommitResult.COMMITTED:
             self.committed_version = reply.version
             return reply.version
         if reply.result == CommitResult.TRANSACTION_TOO_OLD:
             raise TransactionTooOld()
+        if reply.result == CommitResult.UNKNOWN:
+            raise CommitUnknownResult()
         raise NotCommitted()

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Callable
 
-from .client.transaction import Database
+from .client.transaction import ClusterView, Database
 from .conflict.api import ConflictSet
 from .conflict.oracle import OracleConflictSet
 from .roles.proxy import CommitProxy, KeyPartitionMap
@@ -107,7 +107,7 @@ class SimCluster:
                 self._ref(self.proxy_proc, t.commit_stream.endpoint) for t in self.tlogs
             ],
             storage_tags=storage_tag_map,
-            tag_to_tlog={f"ss-{i}": i % n_tlogs for i in range(n_storage_shards)},
+            tag_to_tlogs={f"ss-{i}": [i % n_tlogs] for i in range(n_storage_shards)},
         )
 
         self.client_proc = self.net.create_process("client")
@@ -124,14 +124,12 @@ class SimCluster:
             }
             for ss in self.storage
         ]
-        smap = KeyPartitionMap(self.storage_splits, storage_members)
-        return Database(
-            self.loop,
+        view = ClusterView(
             grv_ref=self._ref(proc, self.proxy.grv_stream.endpoint),
             commit_ref=self._ref(proc, self.proxy.commit_stream.endpoint),
-            storage_map=smap,
-            rng=self.rng,
+            storage_map=KeyPartitionMap(self.storage_splits, storage_members),
         )
+        return Database(self.loop, view, self.rng)
 
     def run_until(self, fut, deadline: float | None = None):
         return self.loop.run_until(fut, deadline)

@@ -1,0 +1,364 @@
+"""Cluster controller + master recovery — generation management
+(fdbserver/ClusterController.actor.cpp; masterserver.actor.cpp:1177-1338
+RecoveryState machine; SURVEY §3.3).
+
+The controller (elected via control/election.py in the full topology, or
+constructed directly) owns the write pipeline's lifecycle:
+
+  * builds generation N's roles (sequencer, proxies, resolvers, TLogs) on
+    worker processes,
+  * heartbeats every pipeline process; a missed FAILURE_TIMEOUT triggers
+    recovery (the reference's waitFailure + masterserver restart),
+  * recovery walks the reference's states: READING_CSTATE (coordinators) →
+    LOCKING_CSTATE (lock surviving old TLogs, establishing the recovery
+    version = min over their end versions — any version acked by *all*
+    replicas is below it) → RECRUITING (fresh roles on live workers; new
+    TLogs seeded with the locked generation's unpopped tag data; resolvers
+    start empty with oldest = recovery version, the state-evaporates
+    simplification the reference's design grants, SURVEY §5) →
+    WRITING_CSTATE (new generation into the coordinators; a stale master
+    loses here and halts) → ACCEPTING_COMMITS,
+  * updates every client's ClusterView and every storage server's TLog
+    source, so readers/writers follow the new generation.
+
+Storage servers live *outside* generations (they rejoin by tag), exactly as
+in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ..client.transaction import ClusterView, Database
+from ..conflict.api import ConflictSet
+from ..roles.proxy import CommitProxy, KeyPartitionMap
+from ..roles.resolver import Resolver
+from ..roles.sequencer import Sequencer
+from ..roles.storage import StorageServer
+from ..roles.tlog import TLog
+from ..roles.types import TLogLockReply, TLogLockRequest, Version
+from ..rpc.network import Endpoint, SimNetwork, SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+from ..runtime.combinators import wait_all, wait_any
+from ..runtime.core import DeterministicRandom, EventLoop, TaskPriority, TimedOut
+from ..runtime.knobs import CoreKnobs
+from ..runtime.trace import TraceCollector
+
+
+class RecoveryState:
+    """Reference fdbserver/RecoveryState.h:30 names."""
+
+    READING_CSTATE = "reading_cstate"
+    LOCKING_CSTATE = "locking_cstate"
+    RECRUITING = "recruiting"
+    WRITING_CSTATE = "writing_cstate"
+    ACCEPTING_COMMITS = "accepting_commits"
+    FULLY_RECOVERED = "fully_recovered"
+
+
+@dataclasses.dataclass
+class GenerationRoles:
+    epoch: int
+    sequencer: Sequencer
+    proxy: CommitProxy
+    resolvers: list[Resolver]
+    tlogs: list[TLog]
+    processes: list[SimProcess]
+    ping_tasks: list = dataclasses.field(default_factory=list)
+
+
+class ClusterController:
+    """Owns generations of the write pipeline over a pool of workers."""
+
+    def __init__(
+        self,
+        loop: EventLoop,
+        net: SimNetwork,
+        knobs: CoreKnobs,
+        rng: DeterministicRandom,
+        trace: TraceCollector,
+        storage: list[StorageServer],
+        storage_splits: list[bytes],
+        conflict_backend: Callable[..., ConflictSet],
+        resolver_splits: list[bytes],
+        n_tlogs: int = 2,
+        cstate=None,  # CoordinatedState or None (tests without coordinators)
+    ) -> None:
+        self.loop = loop
+        self.net = net
+        self.knobs = knobs
+        self.rng = rng.split()
+        self.trace = trace
+        self.storage = storage
+        self.storage_splits = storage_splits
+        self.resolver_splits = resolver_splits
+        self.make_cs = conflict_backend
+        self.n_tlogs = n_tlogs
+        self.cstate = cstate
+        self.epoch = 0
+        self.recoveries = 0
+        self.generation: GenerationRoles | None = None
+        self.views: list[ClusterView] = []
+        self.recovery_state = RecoveryState.READING_CSTATE
+        self._recovering = False
+        self._monitor_task = None
+        self._proc_seq = 0
+
+    def _set_state(self, state: str) -> None:
+        self.recovery_state = state
+        self.trace.trace(
+            "MasterRecoveryState", track_latest="master",
+            State=state, Epoch=self.epoch,
+        )
+
+    # -- process pool -------------------------------------------------------
+    def _new_proc(self, role: str) -> SimProcess:
+        self._proc_seq += 1
+        return self.net.create_process(f"{role}-e{self.epoch}-{self._proc_seq}")
+
+    # -- bootstrap ----------------------------------------------------------
+    async def start(self) -> None:
+        await self._recover(first=True)
+        self._monitor_task = self.loop.spawn(
+            self._monitor(), TaskPriority.COORDINATION, "cc-monitor"
+        )
+
+    # -- recovery state machine --------------------------------------------
+    async def _recover(self, first: bool = False) -> None:
+        if self._recovering:
+            return
+        self._recovering = True
+        try:
+            self.epoch += 1
+            if not first:
+                self.recoveries += 1
+            self._set_state(RecoveryState.READING_CSTATE)
+            old = self.generation
+
+            # LOCKING_CSTATE: stop the old generation's TLogs, learn the
+            # recovery version and surviving tag data
+            self._set_state(RecoveryState.LOCKING_CSTATE)
+            recovery_version, tag_data = await self._lock_old_tlogs(old)
+
+            # RECRUITING: fresh pipeline on fresh processes
+            self._set_state(RecoveryState.RECRUITING)
+            if old is not None:
+                for p in old.processes:
+                    p.kill()  # old roles may not serve a split-brain
+                for t in old.ping_tasks:
+                    t.cancel()
+            gen = self._recruit(recovery_version, tag_data)
+
+            # WRITING_CSTATE: publish via coordinators (stale CC halts here)
+            self._set_state(RecoveryState.WRITING_CSTATE)
+            if self.cstate is not None:
+                ok = await self.cstate.write(
+                    {"epoch": self.epoch, "recovery_version": recovery_version}
+                )
+                if not ok:
+                    for p in gen.processes:
+                        p.kill()
+                    raise RuntimeError("lost cstate race: a newer master exists")
+
+            self.generation = gen
+            self._set_state(RecoveryState.ACCEPTING_COMMITS)
+            self._rewire(gen)
+            self._set_state(RecoveryState.FULLY_RECOVERED)
+        finally:
+            self._recovering = False
+
+    async def _lock_old_tlogs(self, old: GenerationRoles | None):
+        if old is None:
+            return 0, [dict() for _ in range(self.n_tlogs)]
+        replies: list[TLogLockReply | None] = []
+        for t in old.tlogs:
+            ref = RequestStreamRef(self.net, self._cc_proc(), t.lock_stream.endpoint)
+            try:
+                replies.append(await ref.get_reply(TLogLockRequest(), timeout=1.0))
+            except TimedOut:
+                replies.append(None)  # that TLog is gone
+        alive = [r for r in replies if r is not None]
+        if not alive:
+            raise RuntimeError("all TLogs lost: unrecoverable data loss")
+        # a committed version was acked by EVERY TLog (the proxy waits on
+        # all of them before replying), so it is <= every survivor's end;
+        # min over survivors keeps all committed data and drops any torn
+        # partially-pushed suffix consistently across tags (the reference's
+        # recovery-version rule)
+        recovery_version = min(r.end_version for r in alive)
+        # rebuild per-new-tlog tag seeds from surviving replicas
+        merged: dict[str, list] = {}
+        for r in alive:
+            for tag, entries in r.tags.items():
+                cur = merged.setdefault(tag, [])
+                have = {v for v, _ in cur}
+                cur.extend((v, m) for v, m in entries if v not in have)
+        seeds = [dict() for _ in range(self.n_tlogs)]
+        for tag, entries in merged.items():
+            entries.sort(key=lambda e: e[0])
+            entries = [e for e in entries if e[0] <= recovery_version]
+            for idx in self._tag_tlogs(tag):
+                seeds[idx][tag] = list(entries)  # per-replica copy: the new
+                # TLogs append to these lists independently
+        return recovery_version, seeds
+
+    def _tag_tlogs(self, tag: str) -> list[int]:
+        """TLog replica set for a tag: primary + next (2x log replication —
+        the reference replicates each mutation to a TLog team under policy;
+        one TLog loss keeps every tag recoverable)."""
+        primary = int(tag.split("-")[-1]) % self.n_tlogs
+        if self.n_tlogs == 1:
+            return [0]
+        return [primary, (primary + 1) % self.n_tlogs]
+
+    def _cc_proc(self) -> SimProcess:
+        if not hasattr(self, "_cc_process"):
+            self._cc_process = self.net.create_process("cluster-controller")
+        return self._cc_process
+
+    def _recruit(self, recovery_version: Version, tlog_seeds: list[dict]) -> GenerationRoles:
+        procs: list[SimProcess] = []
+        ping_tasks: list = []
+
+        def add_ping(p: SimProcess) -> None:
+            rs = RequestStream(p, "wlt:ping")
+
+            async def pong() -> None:
+                while True:
+                    req = await rs.next()
+                    req.reply("pong")
+
+            ping_tasks.append(self.loop.spawn(pong(), TaskPriority.COORDINATION))
+
+        seq_proc = self._new_proc("sequencer")
+        procs.append(seq_proc)
+        add_ping(seq_proc)
+        # jump versions past anything the old generation might have handed
+        # out but never logged (reference: recovery version gap)
+        sequencer = Sequencer(
+            seq_proc, self.loop, self.knobs,
+            start_version=recovery_version + 1_000_000,
+        )
+
+        tlogs: list[TLog] = []
+        for i in range(self.n_tlogs):
+            p = self._new_proc(f"tlog{i}")
+            procs.append(p)
+            add_ping(p)
+            tlogs.append(
+                TLog(p, self.loop, start_version=recovery_version + 1_000_000,
+                     initial_tags=tlog_seeds[i])
+            )
+
+        resolvers: list[Resolver] = []
+        for i in range(len(self.resolver_splits) + 1):
+            p = self._new_proc(f"resolver{i}")
+            procs.append(p)
+            add_ping(p)
+            resolvers.append(
+                Resolver(
+                    p, self.loop, self.knobs,
+                    self.make_cs(recovery_version),
+                    start_version=recovery_version + 1_000_000,
+                )
+            )
+
+        proxy_proc = self._new_proc("proxy")
+        procs.append(proxy_proc)
+        add_ping(proxy_proc)
+        tags = [f"ss-{i}" for i in range(len(self.storage_splits) + 1)]
+        proxy = CommitProxy(
+            proxy_proc, self.loop, self.knobs,
+            sequencer_ref=RequestStreamRef(self.net, proxy_proc, sequencer.stream.endpoint),
+            resolver_refs=[
+                RequestStreamRef(self.net, proxy_proc, r.stream.endpoint)
+                for r in resolvers
+            ],
+            resolver_splits=self.resolver_splits,
+            tlog_refs=[
+                RequestStreamRef(self.net, proxy_proc, t.commit_stream.endpoint)
+                for t in tlogs
+            ],
+            storage_tags=KeyPartitionMap(self.storage_splits, tags),
+            tag_to_tlogs={t: self._tag_tlogs(t) for t in tags},
+            start_version=recovery_version + 1_000_000,
+        )
+        return GenerationRoles(
+            self.epoch, sequencer, proxy, resolvers, tlogs, procs, ping_tasks
+        )
+
+    def _rewire(self, gen: GenerationRoles) -> None:
+        """Point storage servers and every registered client view at the new
+        generation (the MonitorLeader push)."""
+        for ss in self.storage:
+            tlog = gen.tlogs[self._tag_tlogs(ss.tag)[0]]
+            ss.set_tlog_source(
+                RequestStreamRef(self.net, ss.process, tlog.peek_stream.endpoint),
+                RequestStreamRef(self.net, ss.process, tlog.pop_stream.endpoint),
+            )
+        for view in self.views:
+            self._fill_view(view)
+
+    def _fill_view(self, view: ClusterView) -> None:
+        gen = self.generation
+        client_proc = view._client_proc
+        view.grv = RequestStreamRef(self.net, client_proc, gen.proxy.grv_stream.endpoint)
+        view.commit = RequestStreamRef(self.net, client_proc, gen.proxy.commit_stream.endpoint)
+        view.smap = KeyPartitionMap(
+            self.storage_splits,
+            [
+                {
+                    "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
+                    "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
+                }
+                for ss in self.storage
+            ],
+        )
+        view.epoch = self.epoch
+
+    def make_view(self, client_proc: SimProcess) -> ClusterView:
+        view = ClusterView(None, None, None)
+        view._client_proc = client_proc
+        self._fill_view(view)
+        self.views.append(view)
+        return view
+
+    # -- failure monitoring -------------------------------------------------
+    async def _monitor(self) -> None:
+        """Heartbeat every pipeline process (the CC's failure monitor; the
+        reference aggregates heartbeats + per-role waitFailure endpoints).
+        A ping unanswered within FAILURE_TIMEOUT — kill, reboot, or
+        partition — triggers a new generation."""
+        cc = self._cc_proc()
+        while True:
+            await self.loop.delay(self.knobs.HEARTBEAT_INTERVAL, TaskPriority.COORDINATION)
+            gen = self.generation
+            if gen is None or self._recovering:
+                continue
+            dead: list[str] = []
+            for p in gen.processes:
+                ref = RequestStreamRef(self.net, cc, Endpoint(p.address, "wlt:ping"))
+                try:
+                    await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
+                except TimedOut:
+                    dead.append(p.name)
+            if dead and self.generation is gen:
+                self.trace.trace(
+                    "MasterRecoveryTriggered", Dead=dead, Epoch=self.epoch,
+                )
+                try:
+                    await self._recover()
+                except Exception as e:  # noqa: BLE001 — transient quorum
+                    # loss etc. must not kill the monitor: log and retry on
+                    # the next heartbeat tick
+                    self.trace.trace(
+                        "MasterRecoveryError", Error=repr(e), Epoch=self.epoch,
+                    )
+
+    def stop(self) -> None:
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+        if self.generation is not None:
+            for p in self.generation.processes:
+                p.kill()

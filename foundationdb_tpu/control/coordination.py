@@ -1,0 +1,165 @@
+"""Coordinators: replicated generation registers — the cluster's ground truth
+(fdbserver/Coordination.actor.cpp: GenerationRegVal :31, localGenerationReg
+:125; CoordinatedState quorum logic fdbserver/CoordinatedState.actor.cpp).
+
+Each coordinator holds a single versioned register (the serialized cluster
+state).  Reads and writes use the Paxos-register discipline the reference
+uses: a client first `read`s with a fresh read-generation from a majority
+(learning the newest value and the highest write-generation seen), then
+`write`s with a higher generation to a majority.  Two masters racing for
+the register cannot both succeed — the loser's generation is stale and a
+majority rejects it, which is exactly how split-brain is prevented during
+recovery (SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+from ..runtime.combinators import wait_any
+from ..runtime.core import EventLoop, Future, Promise, TaskPriority, TimedOut
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Generation:
+    """(batch, id) ordered pair (reference UniqueGeneration)."""
+
+    number: int
+    owner: str
+
+
+GEN_ZERO = Generation(0, "")
+
+
+@dataclasses.dataclass
+class ReadRegRequest:
+    read_gen: Generation
+
+
+@dataclasses.dataclass
+class ReadRegReply:
+    value: Any
+    write_gen: Generation   # generation that wrote `value`
+    read_gen: Generation    # highest read/write generation promised
+
+
+@dataclasses.dataclass
+class WriteRegRequest:
+    value: Any
+    write_gen: Generation
+
+
+@dataclasses.dataclass
+class WriteRegReply:
+    ok: bool
+    promised: Generation
+
+
+class Coordinator:
+    """One coordination server: a durable generation register."""
+
+    WLT_READ = "wlt:coord_read"
+    WLT_WRITE = "wlt:coord_write"
+
+    def __init__(self, process: SimProcess, loop: EventLoop) -> None:
+        self.process = process
+        self.loop = loop
+        self.value: Any = None
+        self.write_gen: Generation = GEN_ZERO
+        self.promised: Generation = GEN_ZERO
+        self.read_stream = RequestStream(process, self.WLT_READ)
+        self.write_stream = RequestStream(process, self.WLT_WRITE)
+        self._tasks = [
+            loop.spawn(self._serve_read(), TaskPriority.COORDINATION, "coord-read"),
+            loop.spawn(self._serve_write(), TaskPriority.COORDINATION, "coord-write"),
+        ]
+
+    async def _serve_read(self) -> None:
+        while True:
+            req = await self.read_stream.next()
+            r: ReadRegRequest = req.payload
+            if r.read_gen > self.promised:
+                self.promised = r.read_gen
+            req.reply(ReadRegReply(self.value, self.write_gen, self.promised))
+
+    async def _serve_write(self) -> None:
+        while True:
+            req = await self.write_stream.next()
+            r: WriteRegRequest = req.payload
+            if r.write_gen >= self.promised:
+                self.promised = r.write_gen
+                self.write_gen = r.write_gen
+                self.value = r.value
+                req.reply(WriteRegReply(True, self.promised))
+            else:
+                req.reply(WriteRegReply(False, self.promised))
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self.read_stream.close()
+        self.write_stream.close()
+
+
+class CoordinatedState:
+    """Majority-quorum client over the coordinators (CoordinatedState.actor.cpp):
+    read-then-conditional-write of the replicated cluster state."""
+
+    def __init__(self, loop: EventLoop, read_refs: list[RequestStreamRef],
+                 write_refs: list[RequestStreamRef], owner: str) -> None:
+        self.loop = loop
+        self._reads = read_refs
+        self._writes = write_refs
+        self._owner = owner
+        self._gen_number = 0
+
+    @property
+    def quorum_size(self) -> int:
+        return len(self._reads) // 2 + 1
+
+    async def _majority(self, futures: list[Future]) -> list:
+        """Collect replies until a majority succeeded (ignores the rest)."""
+        need = self.quorum_size
+        got: list = []
+        pending = list(futures)
+        while pending and len(got) < need:
+            idx, result = await wait_any(pending)
+            got.append(result)
+            pending.pop(idx)
+        if len(got) < need:
+            raise TimedOut("no coordinator quorum")
+        return got
+
+    async def read(self) -> tuple[Any, Generation]:
+        self._gen_number += 1
+        rg = Generation(self._gen_number, self._owner)
+        replies = await self._majority(
+            [ref.get_reply(ReadRegRequest(rg), timeout=2.0) for ref in self._reads]
+        )
+        # newest write wins; also learn any higher promised generation
+        best = max(replies, key=lambda r: r.write_gen)
+        top_promise = max(r.read_gen for r in replies)
+        if top_promise.number > self._gen_number:
+            self._gen_number = top_promise.number
+        return best.value, best.write_gen
+
+    async def write(self, value: Any) -> bool:
+        """Conditional write with a fresh higher generation; False = lost the
+        race to a newer writer (caller must re-read and reconsider)."""
+        self._gen_number += 1
+        wg = Generation(self._gen_number, self._owner)
+        replies = await self._majority(
+            [
+                ref.get_reply(WriteRegRequest(value, wg), timeout=2.0)
+                for ref in self._writes
+            ]
+        )
+        ok = sum(1 for r in replies if r.ok) >= self.quorum_size
+        if not ok:
+            top = max(r.promised for r in replies)
+            if top.number > self._gen_number:
+                self._gen_number = top.number
+        return ok

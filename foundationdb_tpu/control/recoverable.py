@@ -1,0 +1,98 @@
+"""RecoverableCluster: the full topology — coordinators + controller-managed
+write pipeline + persistent storage servers — under one deterministic loop.
+
+This is SimCluster's fault-tolerant sibling (the difference mirrors the
+reference: SimCluster wires one static generation; here the
+ClusterController owns generations and survives pipeline kills).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..client.transaction import Database
+from ..conflict.oracle import OracleConflictSet
+from ..roles.storage import MemoryKeyValueStore, StorageServer
+from ..rpc.network import SimNetwork
+from ..rpc.stream import RequestStreamRef
+from ..runtime.core import DeterministicRandom, EventLoop
+from ..runtime.knobs import CoreKnobs
+from ..runtime.trace import TraceCollector
+from .controller import ClusterController
+from .coordination import CoordinatedState, Coordinator
+
+
+class RecoverableCluster:
+    def __init__(
+        self,
+        seed: int = 0,
+        n_resolvers: int = 1,
+        n_storage_shards: int = 1,
+        n_tlogs: int = 2,
+        n_coordinators: int = 3,
+        conflict_backend: Callable[..., object] | None = None,
+        knobs: CoreKnobs | None = None,
+    ) -> None:
+        self.loop = EventLoop()
+        self.rng = DeterministicRandom(seed)
+        self.knobs = knobs or CoreKnobs()
+        self.trace = TraceCollector(clock=self.loop.now)
+        self.net = SimNetwork(self.loop, self.rng, self.trace)
+        make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))
+
+        def splits(n: int) -> list[bytes]:
+            return [bytes([256 * i // n]) for i in range(1, n)]
+
+        self.storage_splits = splits(n_storage_shards)
+        resolver_splits = splits(n_resolvers)
+
+        self.coordinators = [
+            Coordinator(self.net.create_process(f"coord-{i}"), self.loop)
+            for i in range(n_coordinators)
+        ]
+
+        # storage servers persist across generations
+        self.storage: list[StorageServer] = []
+        for i in range(n_storage_shards):
+            p = self.net.create_process(f"storage-{i}")
+            # initial refs are dummies; the controller rewires on first recovery
+            self.storage.append(
+                StorageServer(
+                    p, self.loop, self.knobs,
+                    tlog_peek_ref=None, tlog_pop_ref=None,
+                    tag=f"ss-{i}", store=MemoryKeyValueStore(),
+                )
+            )
+
+        cc_proc = self.net.create_process("cc-election")
+        cstate = CoordinatedState(
+            self.loop,
+            [RequestStreamRef(self.net, cc_proc, c.read_stream.endpoint) for c in self.coordinators],
+            [RequestStreamRef(self.net, cc_proc, c.write_stream.endpoint) for c in self.coordinators],
+            owner="cc",
+        )
+        self.controller = ClusterController(
+            self.loop, self.net, self.knobs, self.rng, self.trace,
+            storage=self.storage,
+            storage_splits=self.storage_splits,
+            conflict_backend=make_cs,
+            resolver_splits=resolver_splits,
+            n_tlogs=n_tlogs,
+            cstate=cstate,
+        )
+        self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
+
+    def database(self) -> Database:
+        proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")
+        view = self.controller.make_view(proc)
+        return Database(self.loop, view, self.rng)
+
+    def run_until(self, fut, deadline: float | None = None):
+        return self.loop.run_until(fut, deadline)
+
+    def stop(self) -> None:
+        self.controller.stop()
+        for c in self.coordinators:
+            c.stop()
+        for s in self.storage:
+            s.stop()

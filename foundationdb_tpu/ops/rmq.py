@@ -21,8 +21,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-U32_MAX = jnp.uint32(0xFFFFFFFF)
-I32_MAX = jnp.int32(0x7FFFFFFF)
+# Python ints, not jnp scalars: as jit-time constants they fold into the
+# compiled program; device-array identities made TPU sparse-table builds
+# ~5x slower (the concat pads became runtime broadcasts).
+U32_MAX = 0xFFFFFFFF
+I32_MAX = 0x7FFFFFFF
 
 
 def _levels(n: int) -> int:

@@ -38,7 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import keys as keymod
 from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
-from ..conflict.device import _SENT_WORD, pack_batch, resolve_core
+from ..conflict.device import _SENT_WORD, N_BUCKETS, pack_batch, resolve_core
+from ..ops.rmq import _levels
 from ..ops.search import lex_less
 
 RESOLVER_AXIS = "resolvers"
@@ -77,7 +78,7 @@ def _clip_ranges(b, e, tx, lo_row, hi_row):
 
 
 def _sharded_resolve(
-    ks, vs,  # per-device state shards: [1, CAP, W], [1, CAP]
+    ks, vs, cnt,  # per-device state shards: [1, CAP, W], [1, CAP], [1]
     lo, hi,  # per-device partition bounds: [1, W] each
     rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,  # replicated batch
     *, cap, n_txn, n_read, n_write,
@@ -85,9 +86,14 @@ def _sharded_resolve(
     ks, vs, lo, hi = ks[0], vs[0], lo[0], hi[0]
     rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
     wb, we, w_tx = _clip_ranges(wb, we, w_tx, lo, hi)
-    verdict, new_ks, new_vs, new_count = resolve_core(
-        ks, vs, rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    # full-depth search (bucket index unused at full depth): partition caps
+    # are small, and it keeps the sharded path free of fallback control flow
+    dummy_bidx = jnp.zeros(N_BUCKETS + 1, jnp.int32)
+    verdict, new_ks, new_vs, new_count, _bidx, _conv = resolve_core(
+        ks, vs, dummy_bidx, cnt[0], rb, re_, r_tx, wb, we, w_tx, snap, active,
+        commit_off,
         cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write,
+        search_iters=_levels(cap) + 1,
     )
     # proxy min-combine (MasterProxyServer.actor.cpp:558-569) over ICI
     merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
@@ -111,7 +117,7 @@ def build_sharded_resolver(mesh: Mesh, *, cap: int, n_txn: int, n_read: int, n_w
             _sharded_resolve, cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write
         ),
         mesh=mesh,
-        in_specs=(shard, shard, shard, shard) + (repl,) * 9,
+        in_specs=(shard, shard, shard, shard, shard) + (repl,) * 9,
         out_specs=(repl, shard, shard, shard),
         # the kernel's loop carries start replicated and become varying;
         # skip the static replication check rather than pcast every carry
@@ -168,6 +174,7 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._lo, self._hi = dev(lo), dev(hi)
         self._ks, self._vs = dev(ks), dev(vs)
         self._counts = np.ones(n, dtype=np.int64)
+        self._dev_counts = dev(np.ones(n, dtype=np.int32))
 
     @property
     def oldest_version(self) -> int:
@@ -204,7 +211,7 @@ class ShardedDeviceConflictSet(ConflictSet):
 
         fn = self._fn(Bp, R, Wn)
         verdict, new_ks, new_vs, new_counts = fn(
-            self._ks, self._vs, self._lo, self._hi,
+            self._ks, self._vs, self._dev_counts, self._lo, self._hi,
             rbv, rev, rtv, wbv, wev, wtv,
             snap_p, active_p, np.int32(self._offset(commit_version)),
         )
@@ -215,6 +222,7 @@ class ShardedDeviceConflictSet(ConflictSet):
                 "raise capacity or remove_before more often"
             )
         self._ks, self._vs, self._counts = new_ks, new_vs, counts
+        self._dev_counts = new_counts
         self._last_commit = commit_version
         codes = np.asarray(verdict)[:B]
         return [Verdict(int(c)) for c in codes]

@@ -38,7 +38,7 @@ from .types import (
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all
-from ..runtime.core import EventLoop, FutureStream, TaskPriority
+from ..runtime.core import EventLoop, FutureStream, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import CounterCollection
 
@@ -92,7 +92,7 @@ class CommitProxy:
         resolver_splits: list[bytes],
         tlog_refs: list[RequestStreamRef],
         storage_tags: KeyPartitionMap,
-        tag_to_tlog: dict[str, int] | None = None,
+        tag_to_tlogs: dict[str, list[int]] | None = None,
         start_version: Version = 0,
     ) -> None:
         self.loop = loop
@@ -102,9 +102,9 @@ class CommitProxy:
         self.rmap = KeyPartitionMap(resolver_splits, list(range(len(resolver_refs))))
         self.tlogs = tlog_refs
         self.tags = storage_tags
-        # which TLog stores each tag (TagPartitionedLogSystem's tag->log
-        # locality); default: every tag on tlog 0
-        self.tag_to_tlog = tag_to_tlog or {t: 0 for t in storage_tags.members}
+        # which TLog replicas store each tag (TagPartitionedLogSystem's
+        # tag->log-team mapping); default: every tag on tlog 0
+        self.tag_to_tlogs = tag_to_tlogs or {t: [0] for t in storage_tags.members}
         self.committed_version = NotifiedVersion(start_version)
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
         self.grv_stream = RequestStream(process, self.WLT_GRV)
@@ -148,9 +148,20 @@ class CommitProxy:
 
     # -- phases 2-5 ----------------------------------------------------------
     async def _commit_batch(self, batch: list[_PendingCommit]) -> None:
+        try:
+            await self._commit_batch_inner(batch)
+        except TimedOut:
+            # a downstream role (sequencer/resolver/tlog) is unreachable:
+            # this generation is ending.  The txns may or may not land once
+            # recovery replays surviving logs — reply UNKNOWN, the client's
+            # commit_unknown_result path (NativeAPI.actor.cpp:2482-2502)
+            for pc in batch:
+                pc.reply_cb.reply(CommitReply(CommitResult.UNKNOWN))
+
+    async def _commit_batch_inner(self, batch: list[_PendingCommit]) -> None:
         self.c_batches.add(1)
         gv: GetCommitVersionReply = await self.sequencer.get_reply(
-            GetCommitVersionRequest(requesting_proxy="proxy")
+            GetCommitVersionRequest(requesting_proxy="proxy"), timeout=2.0
         )
         prev_v, version = gv.prev_version, gv.version
 
@@ -174,7 +185,8 @@ class CommitProxy:
         replies = await wait_all(
             [
                 self.resolvers[r].get_reply(
-                    ResolveTransactionBatchRequest(prev_v, version, per_res[r])
+                    ResolveTransactionBatchRequest(prev_v, version, per_res[r]),
+                    timeout=2.0,
                 )
                 for r in range(n_res)
             ]
@@ -202,10 +214,11 @@ class CommitProxy:
         # even on empty batches) but only stores its own tags' mutations
         per_tlog: list[dict[str, list[Mutation]]] = [dict() for _ in self.tlogs]
         for tag, muts in by_tag.items():
-            per_tlog[self.tag_to_tlog[tag]][tag] = muts
+            for idx in self.tag_to_tlogs[tag]:
+                per_tlog[idx][tag] = muts
         await wait_all(
             [
-                t.get_reply(TLogCommitRequest(prev_v, version, per_tlog[i]))
+                t.get_reply(TLogCommitRequest(prev_v, version, per_tlog[i]), timeout=2.0)
                 for i, t in enumerate(self.tlogs)
             ]
         )

@@ -167,6 +167,7 @@ class StorageServer:
         self.tlog = tlog_peek_ref
         self.tlog_pop = tlog_pop_ref
         self.tag = tag
+        self.process = process
         self.store = store or MemoryKeyValueStore()
         self.overlay = VersionedOverlay()
         self.version = NotifiedVersion(start_version)   # newest applied
@@ -184,6 +185,9 @@ class StorageServer:
     # -- write path: pull from TLog -----------------------------------------
     async def _pull(self) -> None:
         while True:
+            if self.tlog is None:  # no log system yet (pre-first-recovery)
+                await self.loop.delay(0.05, TaskPriority.STORAGE_SERVER)
+                continue
             try:
                 reply = await self.tlog.get_reply(
                     TLogPeekRequest(self.tag, self._fetched + 1), timeout=1.0
@@ -218,7 +222,8 @@ class StorageServer:
                     flush_to, self.store.set, self.store.clear_range
                 )
                 self.durable_version = flush_to
-                self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))
+                if self.tlog_pop is not None:
+                    self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))
 
     # -- read path ----------------------------------------------------------
     async def _wait_version(self, version: Version) -> None:
@@ -270,6 +275,13 @@ class StorageServer:
                 break
         more = len(out) > r.limit
         req.reply(GetKeyValuesReply(out[: r.limit], more))
+
+    def set_tlog_source(self, peek_ref: RequestStreamRef, pop_ref: RequestStreamRef) -> None:
+        """Re-point at a new TLog generation (recovery: storage servers
+        rejoin the new log system by tag — SURVEY §5).  The pull loop reads
+        these refs each iteration, so the switch takes effect immediately."""
+        self.tlog = peek_ref
+        self.tlog_pop = pop_ref
 
     def stop(self) -> None:
         for t in self._tasks:

@@ -18,6 +18,8 @@ import bisect
 from .sequencer import NotifiedVersion
 from .types import (
     TLogCommitRequest,
+    TLogLockReply,
+    TLogLockRequest,
     TLogPeekReply,
     TLogPeekRequest,
     TLogPopRequest,
@@ -32,23 +34,28 @@ class TLog:
     WLT_COMMIT = "wlt:tlog_commit"
     WLT_PEEK = "wlt:tlog_peek"
     WLT_POP = "wlt:tlog_pop"
+    WLT_LOCK = "wlt:tlog_lock"
 
     def __init__(self, process: SimProcess, loop: EventLoop,
-                 start_version: Version = 0, sync_delay: float = 0.0005) -> None:
+                 start_version: Version = 0, sync_delay: float = 0.0005,
+                 initial_tags: dict | None = None) -> None:
         self.loop = loop
         self.process = process
         self.sync_delay = sync_delay
         self.version = NotifiedVersion(start_version)
+        self.locked = False
         # per-tag: sorted list of (version, [Mutation]); popped prefix removed
-        self._tags: dict[str, list[tuple[Version, list]]] = {}
+        self._tags: dict[str, list[tuple[Version, list]]] = dict(initial_tags or {})
         self._poppable: dict[str, Version] = {}
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
         self.peek_stream = RequestStream(process, self.WLT_PEEK)
         self.pop_stream = RequestStream(process, self.WLT_POP)
+        self.lock_stream = RequestStream(process, self.WLT_LOCK)
         self._tasks = [
             loop.spawn(self._serve_commit(), TaskPriority.TLOG_COMMIT, "tlog-commit"),
             loop.spawn(self._serve_peek(), TaskPriority.TLOG_COMMIT, "tlog-peek"),
             loop.spawn(self._serve_pop(), TaskPriority.TLOG_COMMIT, "tlog-pop"),
+            loop.spawn(self._serve_lock(), TaskPriority.TLOG_COMMIT, "tlog-lock"),
         ]
 
     # -- commit ------------------------------------------------------------
@@ -59,7 +66,11 @@ class TLog:
 
     async def _commit_one(self, req) -> None:
         r: TLogCommitRequest = req.payload
+        if self.locked:
+            return  # locked by recovery: never ack, the old generation ends
         await self.version.when_at_least(r.prev_version)
+        if self.locked:
+            return
         if self.version.get() >= r.version:
             # duplicate push (proxy retry): already logged, ack again
             req.reply(r.version)
@@ -95,6 +106,16 @@ class TLog:
             if i:
                 self._tags[r.tag] = q[i:]
             req.reply(None)
+
+    # -- lock (recovery) ----------------------------------------------------
+    async def _serve_lock(self) -> None:
+        while True:
+            req = await self.lock_stream.next()
+            assert isinstance(req.payload, TLogLockRequest)
+            self.locked = True
+            req.reply(
+                TLogLockReply(end_version=self.version.get(), tags=dict(self._tags))
+            )
 
     @property
     def bytes_queued(self) -> int:

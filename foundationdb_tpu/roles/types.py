@@ -105,6 +105,9 @@ class CommitResult(enum.Enum):
     COMMITTED = "committed"
     NOT_COMMITTED = "not_committed"          # OCC conflict: retryable
     TRANSACTION_TOO_OLD = "transaction_too_old"
+    UNKNOWN = "commit_unknown_result"        # pipeline failed mid-commit: the
+                                             # txn may or may not have landed
+                                             # (NativeAPI.actor.cpp:2482-2502)
 
 
 @dataclasses.dataclass
@@ -172,6 +175,22 @@ class TLogPopRequest:
     upto_version: Version
 
 
+@dataclasses.dataclass
+class TLogLockRequest:
+    """Recovery: stop accepting commits, hand over state
+    (the reference's TLogLockResult / epoch end, TLogServer.actor.cpp)."""
+
+
+@dataclasses.dataclass
+class TLogLockReply:
+    end_version: Version
+    tags: dict  # tag -> list[(version, [Mutation])] unpopped entries
+
+
+class ClusterRecovering(Exception):
+    """Commit pipeline is between generations; retry shortly."""
+
+
 # ---- GRV ------------------------------------------------------------------
 
 
@@ -223,3 +242,9 @@ class FutureVersion(Exception):
 
 class NotCommitted(Exception):
     pass
+
+
+class CommitUnknownResult(Exception):
+    """The commit may or may not have happened (proxy died / pipeline
+    failover mid-commit).  Retrying is safe only for idempotent or
+    self-verifying transactions — the same contract as the reference."""

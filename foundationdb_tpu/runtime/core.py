@@ -206,7 +206,10 @@ class Task(Future):
     Python rendering of "actor destroyed ⇒ wait() throws actor_cancelled"
     (flow/flow.h:914 Actor)."""
 
-    __slots__ = ("_coro", "_loop", "_priority", "_waiting_on", "name", "_resume_cb", "_cancelled")
+    __slots__ = (
+        "_coro", "_loop", "_priority", "_waiting_on", "name", "_resume_cb",
+        "_cancelled", "_started",
+    )
 
     def __init__(self, coro: Coroutine, loop: "EventLoop", priority: int, name: str) -> None:
         super().__init__()
@@ -216,6 +219,7 @@ class Task(Future):
         self._waiting_on: Future | None = None
         self._resume_cb: Callable | None = None
         self._cancelled = False
+        self._started = False
         self.name = name
 
     def _step(self, send_value: Any = None, throw_err: BaseException | None = None) -> None:
@@ -224,8 +228,15 @@ class Task(Future):
         if self._cancelled and throw_err is None:
             # cancelled before this step ran: like the reference, a destroyed
             # actor's body never executes past the cancellation point
+            if not self._started:
+                # never ran at all: close instead of throwing into it so the
+                # interpreter doesn't warn about an un-awaited coroutine
+                self._coro.close()
+                self._set_error(ActorCancelled())
+                return
             throw_err = ActorCancelled()
         self._waiting_on = None
+        self._started = True
         try:
             if throw_err is not None:
                 awaited = self._coro.throw(throw_err)
@@ -259,6 +270,12 @@ class Task(Future):
         if self.done():
             return
         self._cancelled = True  # any already-queued _step now throws instead
+        if not self._started:
+            # never ran: finish it synchronously (no loop turn needed) so the
+            # coroutine object is closed, not leaked to the GC
+            self._coro.close()
+            self._set_error(ActorCancelled())
+            return
         if self._waiting_on is not None:
             if self._resume_cb is not None:
                 self._waiting_on.remove_done_callback(self._resume_cb)
