@@ -25,15 +25,17 @@ import time
 
 import numpy as np
 
-TXNS_PER_BATCH = 4096
+TXNS_PER_BATCH = 8192  # the BASELINE configs' 10K-class commit batches
 READS_PER_TXN = 2
 TIMED_BATCHES = 16
-PREFILL_BATCHES = 16  # 16 * 4096 point writes ≈ 64K live ranges
+PREFILL_BATCHES = 8  # 8 * 8192 point writes ≈ 64K live ranges at t0
 KEY_BYTES = 16  # reference benchmark key width (performance.rst:14)
-MAX_KEY_BYTES = 20  # holds the 17-byte end key of [k, k+\x00)
+# 16-byte lanes: the [k, k+\x00) end key differs from its begin only in the
+# length lane (the \x00 is zero padding), so 4 data words + length suffice
+MAX_KEY_BYTES = 16
 KEY_POOL = 1 << 20
 WINDOW = PREFILL_BATCHES + TIMED_BATCHES + 2  # no GC mid-run: window covers it
-CAP = 1 << 18
+CAP = 1 << 19
 SEED = 20260729
 
 

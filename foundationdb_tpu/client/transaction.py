@@ -80,6 +80,18 @@ class Database:
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
+    async def watch(self, key: bytes):
+        """Future resolving when `key`'s value changes from its current
+        value (fdbclient watch semantics: register against the storage
+        server owning the key)."""
+        from ..roles.types import WatchValueRequest
+
+        tr = self.create_transaction()
+        current = await tr.get(key, snapshot=True)
+        v = await tr.get_read_version()
+        refs = self._smap.member_for_key(key)
+        return refs["watch"].get_reply(WatchValueRequest(key, current, v))
+
     async def run(self, fn, max_retries: int = 50):
         """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
         errors back off and start over with a fresh read version.

@@ -121,6 +121,7 @@ class SimCluster:
             {
                 "getvalue": self._ref(proc, ss.getvalue_stream.endpoint),
                 "getkeyvalues": self._ref(proc, ss.getkv_stream.endpoint),
+                "watch": self._ref(proc, ss.watch_stream.endpoint),
             }
             for ss in self.storage
         ]

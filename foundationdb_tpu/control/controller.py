@@ -98,6 +98,7 @@ class ClusterController:
         self.cstate = cstate
         self.epoch = 0
         self.recoveries = 0
+        self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
         self.views: list[ClusterView] = []
         self.recovery_state = RecoveryState.READING_CSTATE
@@ -284,6 +285,7 @@ class ClusterController:
             tag_to_tlogs={t: self._tag_tlogs(t) for t in tags},
             start_version=recovery_version + 1_000_000,
         )
+        proxy.ratekeeper = self.ratekeeper
         return GenerationRoles(
             self.epoch, sequencer, proxy, resolvers, tlogs, procs, ping_tasks
         )
@@ -311,6 +313,7 @@ class ClusterController:
                 {
                     "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
                     "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
+                    "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
                 }
                 for ss in self.storage
             ],

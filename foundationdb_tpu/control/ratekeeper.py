@@ -1,0 +1,66 @@
+"""Ratekeeper — global admission control (fdbserver/Ratekeeper.actor.cpp).
+
+Watches storage-server write lag and TLog queue depth and computes a
+cluster-wide transactions-per-second budget (updateRate :250); the proxy's
+GRV service spends that budget, shedding load *before* queues melt down —
+the reference's core flow-control loop.
+"""
+
+from __future__ import annotations
+
+from ..roles.storage import StorageServer
+from ..roles.tlog import TLog
+from ..runtime.core import EventLoop, TaskPriority
+from ..runtime.knobs import CoreKnobs
+
+
+class Ratekeeper:
+    def __init__(
+        self,
+        loop: EventLoop,
+        knobs: CoreKnobs,
+        storage: list[StorageServer],
+        tlogs_fn,  # callable -> current list[TLog] (generation changes)
+        max_tps: float = 1e6,
+    ) -> None:
+        self.loop = loop
+        self.knobs = knobs
+        self.storage = storage
+        self.tlogs_fn = tlogs_fn
+        self.max_tps = max_tps
+        self.tps_budget = max_tps
+        self.smoothed_release = 0.0
+        self.limit_reason = "unlimited"
+        self._task = loop.spawn(self._run(), TaskPriority.RATEKEEPER, "ratekeeper")
+
+    def _update(self) -> None:
+        """One updateRate pass: the binding constraint wins."""
+        tps = self.max_tps
+        reason = "unlimited"
+        target_bytes = self.knobs.TARGET_QUEUE_BYTES
+        for t in self.tlogs_fn():
+            q = t.bytes_queued
+            if q > target_bytes:
+                frac = max(0.0, 1.0 - (q - target_bytes) / target_bytes)
+                if tps > self.max_tps * frac:
+                    tps = self.max_tps * frac
+                    reason = "tlog_queue"
+        window = self.knobs.mvcc_window_versions
+        for ss in self.storage:
+            lag = ss.version.get() - ss.durable_version
+            # durability lag beyond ~2 MVCC windows: storage is drowning
+            if lag > 2 * window:
+                frac = max(0.0, 1.0 - (lag - 2 * window) / window)
+                if tps > self.max_tps * frac:
+                    tps = self.max_tps * frac
+                    reason = "storage_lag"
+        self.tps_budget = max(tps, self.max_tps * 0.01)
+        self.limit_reason = reason
+
+    async def _run(self) -> None:
+        while True:
+            await self.loop.delay(self.knobs.RATEKEEPER_UPDATE_INTERVAL, TaskPriority.RATEKEEPER)
+            self._update()
+
+    def stop(self) -> None:
+        self._task.cancel()

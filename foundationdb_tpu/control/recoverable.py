@@ -81,6 +81,17 @@ class RecoverableCluster:
             cstate=cstate,
         )
         self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
+        from .ratekeeper import Ratekeeper
+
+        self.ratekeeper = Ratekeeper(
+            self.loop, self.knobs, self.storage,
+            tlogs_fn=lambda: (
+                self.controller.generation.tlogs if self.controller.generation else []
+            ),
+        )
+        self.controller.ratekeeper = self.ratekeeper
+        # generation 1 was recruited before the ratekeeper existed
+        self.controller.generation.proxy.ratekeeper = self.ratekeeper
 
     def database(self) -> Database:
         proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")
@@ -91,6 +102,7 @@ class RecoverableCluster:
         return self.loop.run_until(fut, deadline)
 
     def stop(self) -> None:
+        self.ratekeeper.stop()
         self.controller.stop()
         for c in self.coordinators:
             c.stop()

@@ -1,0 +1,75 @@
+"""Cluster status document — the clusterGetStatus analog
+(fdbserver/Status.actor.cpp:1698; schema fdbclient/Schemas.cpp).
+
+Aggregates role counters, trace `track_latest` snapshots, and queue depths
+into one machine-readable dict, the surface `fdbcli status` renders and
+operators script against."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def cluster_status(cluster) -> dict[str, Any]:
+    """Works on SimCluster (static generation) and RecoverableCluster."""
+    loop = cluster.loop
+    trace = cluster.trace
+    controller = getattr(cluster, "controller", None)
+    if controller is not None:
+        gen = controller.generation
+        proxy = gen.proxy
+        resolvers = gen.resolvers
+        tlogs = gen.tlogs
+        epoch = controller.epoch
+        recovery = {
+            "state": controller.recovery_state,
+            "epoch": epoch,
+            "count": controller.recoveries,
+        }
+    else:
+        proxy = cluster.proxy
+        resolvers = cluster.resolvers
+        tlogs = cluster.tlogs
+        recovery = {"state": "accepting_commits", "epoch": 1, "count": 0}
+
+    doc: dict[str, Any] = {
+        "cluster": {
+            "generation": recovery,
+            "clock": loop.now(),
+            "messages_sent": cluster.net.messages_sent,
+            "messages_dropped": cluster.net.messages_dropped,
+            "processes": {
+                str(addr): {"name": p.name, "alive": p.alive, "reboots": p.reboots}
+                for addr, p in cluster.net.processes.items()
+            },
+            "latest_events": {k: v for k, v in trace.latest.items()},
+        },
+        "proxy": {
+            **proxy.counters.snapshot(),
+            "committed_version": proxy.committed_version.get(),
+            "batch_interval": proxy._batch_interval,
+        },
+        "resolvers": [
+            {
+                **r.counters.snapshot(),
+                "version": r.version.get(),
+                "oldest_version": r.cs.oldest_version,
+            }
+            for r in resolvers
+        ],
+        "tlogs": [
+            {"version": t.version.get(), "bytes_queued": t.bytes_queued,
+             "locked": t.locked}
+            for t in tlogs
+        ],
+        "storage": [
+            {
+                "tag": ss.tag,
+                "version": ss.version.get(),
+                "durable_version": ss.durable_version,
+                "keys": ss.store.key_count(),
+            }
+            for ss in cluster.storage
+        ],
+    }
+    return doc

@@ -89,7 +89,7 @@ def _sharded_resolve(
     # full-depth search (bucket index unused at full depth): partition caps
     # are small, and it keeps the sharded path free of fallback control flow
     dummy_bidx = jnp.zeros(N_BUCKETS + 1, jnp.int32)
-    verdict, new_ks, new_vs, new_count, _bidx, _conv = resolve_core(
+    verdict, new_ks, new_vs, new_count, _bidx, _conv, _ok = resolve_core(
         ks, vs, dummy_bidx, cnt[0], rb, re_, r_tx, wb, we, w_tx, snap, active,
         commit_off,
         cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write,

@@ -106,6 +106,9 @@ class CommitProxy:
         # tag->log-team mapping); default: every tag on tlog 0
         self.tag_to_tlogs = tag_to_tlogs or {t: [0] for t in storage_tags.members}
         self.committed_version = NotifiedVersion(start_version)
+        self.ratekeeper = None  # set by the cluster; None = unlimited
+        self._grv_tokens = 10.0
+        self._grv_refill_at = loop.now()
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
         self.grv_stream = RequestStream(process, self.WLT_GRV)
         self.counters = CounterCollection("Proxy")
@@ -238,12 +241,29 @@ class CommitProxy:
                 pc.reply_cb.reply(CommitReply(CommitResult.NOT_COMMITTED))
 
     # -- GRV ------------------------------------------------------------------
+    def _refill_grv_tokens(self) -> None:
+        now = self.loop.now()
+        rate = self.ratekeeper.tps_budget if self.ratekeeper else float("inf")
+        self._grv_tokens = min(
+            self._grv_tokens + (now - self._grv_refill_at) * rate,
+            max(rate * 0.1, 100.0),
+        )
+        self._grv_refill_at = now
+
     async def _grv_server(self) -> None:
         """Batched read-version service (transactionStarter :1052): a read
         version is the newest committed version — causally safe because
-        committed_version only advances after TLog durability."""
+        committed_version only advances after TLog durability.  Transaction
+        starts spend the ratekeeper's cluster-wide budget (the token bucket
+        the reference feeds from ratekeeper to proxies, :508)."""
         while True:
             req = await self.grv_stream.next()
+            if self.ratekeeper is not None:
+                self._refill_grv_tokens()
+                while self._grv_tokens < 1.0:
+                    await self.loop.delay(0.005, TaskPriority.GET_LIVE_VERSION)
+                    self._refill_grv_tokens()
+                self._grv_tokens -= 1.0
             req.reply(GetReadVersionReply(self.committed_version.get()))
 
     def stop(self) -> None:

@@ -31,6 +31,7 @@ from .types import (
     TLogPopRequest,
     TransactionTooOld,
     Version,
+    WatchValueRequest,
     apply_atomic,
 )
 from ..rpc.network import SimProcess
@@ -150,6 +151,7 @@ class VersionedOverlay:
 class StorageServer:
     WLT_GETVALUE = "wlt:ss_getvalue"
     WLT_GETKEYVALUES = "wlt:ss_getkeyvalues"
+    WLT_WATCH = "wlt:ss_watch"
 
     def __init__(
         self,
@@ -175,10 +177,13 @@ class StorageServer:
         self._fetched = start_version
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES)
+        self.watch_stream = RequestStream(process, self.WLT_WATCH)
+        self._watches: dict[bytes, list] = {}  # key -> [(expected, req)]
         self._tasks = [
             loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, f"ss-pull-{tag}"),
             loop.spawn(self._serve_getvalue(), TaskPriority.STORAGE_SERVER, f"ss-gv-{tag}"),
             loop.spawn(self._serve_getkv(), TaskPriority.STORAGE_SERVER, f"ss-gkv-{tag}"),
+            loop.spawn(self._serve_watch(), TaskPriority.STORAGE_SERVER, f"ss-w-{tag}"),
             loop.spawn(self._durability(), TaskPriority.STORAGE_SERVER, f"ss-dur-{tag}"),
         ]
 
@@ -204,6 +209,8 @@ class StorageServer:
                     self.overlay.apply(version, m, self.store.get)
                 self.version.set(version)
                 self._fetched = version
+                if self._watches:
+                    self._fire_watches(muts)
             if reply.end_version - 1 > self.version.get():
                 # tlog knows newer versions with no data for our tag
                 self.version.set(reply.end_version - 1)
@@ -252,6 +259,39 @@ class StorageServer:
             return
         req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
 
+    # -- watches (storageserver watch futures) -------------------------------
+    async def _serve_watch(self) -> None:
+        while True:
+            req = await self.watch_stream.next()
+            r: WatchValueRequest = req.payload
+            current = self.overlay.get(r.key, self.version.get(), self.store.get)
+            if current != r.value:
+                req.reply(self.version.get())  # already changed: fire now
+            else:
+                self._watches.setdefault(r.key, []).append((r.value, req))
+
+    def _fire_watches(self, muts) -> None:
+        touched: set[bytes] = set()
+        for m in muts:
+            if m.type == MutationType.CLEAR_RANGE:
+                touched.update(
+                    k for k in self._watches if m.key <= k < m.value
+                )
+            elif m.key in self._watches:
+                touched.add(m.key)
+        now_v = self.version.get()
+        for k in touched:
+            waiters = self._watches.pop(k, [])
+            still = []
+            for expected, req in waiters:
+                current = self.overlay.get(k, now_v, self.store.get)
+                if current != expected:
+                    req.reply(now_v)
+                else:  # e.g. set to the same value: keep waiting
+                    still.append((expected, req))
+            if still:
+                self._watches[k] = still
+
     async def _serve_getkv(self) -> None:
         while True:
             req = await self.getkv_stream.next()
@@ -288,3 +328,4 @@ class StorageServer:
             t.cancel()
         self.getvalue_stream.close()
         self.getkv_stream.close()
+        self.watch_stream.close()

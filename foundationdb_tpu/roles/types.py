@@ -232,6 +232,16 @@ class GetKeyValuesReply:
     more: bool
 
 
+@dataclasses.dataclass
+class WatchValueRequest:
+    """Resolve when the key's value differs from `value`
+    (storageserver watches; fdbclient watch futures)."""
+
+    key: bytes
+    value: bytes | None
+    version: Version
+
+
 class TransactionTooOld(Exception):
     pass
 

@@ -1,0 +1,157 @@
+"""Interactive admin shell — the fdbcli analog (fdbcli/fdbcli.actor.cpp).
+
+Runs an in-process cluster (simulated world driven by the real clock, the
+same role code production would run) and exposes the operational verbs:
+reads/writes, range scans, status, and chaos (kill a pipeline process to
+watch recovery).  Scriptable: `echo "set k v; get k" | python -m
+foundationdb_tpu.tools.cli`.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+
+from ..client.transaction import Database
+from ..control.recoverable import RecoverableCluster
+from ..control.status import cluster_status
+
+
+HELP = """\
+commands:
+  get <key>                   read a key
+  set <key> <value>           write a key (one transaction)
+  clear <key>                 delete a key
+  clearrange <begin> <end>    delete a range
+  getrange <begin> <end> [n]  scan up to n keys (default 25)
+  watch <key>                 block until the key changes
+  status [json]               cluster status summary (or full json)
+  kill <process-name>         kill a process by name (recovery chaos)
+  processes                   list processes
+  help                        this text
+  exit                        quit
+keys/values are text; use \\xNN escapes for binary."""
+
+
+def _b(s: str) -> bytes:
+    return s.encode("utf-8").decode("unicode_escape").encode("latin-1")
+
+
+class Cli:
+    def __init__(self, seed: int = 0, **cluster_kw) -> None:
+        self.cluster = RecoverableCluster(seed=seed, **cluster_kw)
+        self.db: Database = self.cluster.database()
+
+    def _run(self, coro):
+        return self.cluster.run_until(self.cluster.loop.spawn(coro), 600.0)
+
+    def one_command(self, line: str) -> str:
+        parts = shlex.split(line)
+        if not parts:
+            return ""
+        cmd, *args = parts
+        c = self.cluster
+
+        if cmd == "help":
+            return HELP
+        if cmd == "get":
+            async def go():
+                tr = self.db.create_transaction()
+                return await tr.get(_b(args[0]))
+            v = self._run(go())
+            return repr(v) if v is not None else "<missing>"
+        if cmd == "set":
+            async def go():
+                tr = self.db.create_transaction()
+                tr.set(_b(args[0]), _b(args[1]))
+                return await tr.commit()
+            return f"committed @{self._run(go())}"
+        if cmd == "clear":
+            async def go():
+                tr = self.db.create_transaction()
+                tr.clear(_b(args[0]))
+                return await tr.commit()
+            return f"committed @{self._run(go())}"
+        if cmd == "clearrange":
+            async def go():
+                tr = self.db.create_transaction()
+                tr.clear_range(_b(args[0]), _b(args[1]))
+                return await tr.commit()
+            return f"committed @{self._run(go())}"
+        if cmd == "getrange":
+            limit = int(args[2]) if len(args) > 2 else 25
+            async def go():
+                tr = self.db.create_transaction()
+                return await tr.get_range(_b(args[0]), _b(args[1]), limit=limit)
+            rows = self._run(go())
+            return "\n".join(f"{k!r} -> {v!r}" for k, v in rows) or "<empty>"
+        if cmd == "watch":
+            async def go():
+                fut = await self.db.watch(_b(args[0]))
+                return await fut
+            return f"changed @{self._run(go())}"
+        if cmd == "status":
+            doc = cluster_status(c)
+            if args and args[0] == "json":
+                return json.dumps(doc, indent=2, default=str)
+            g = doc["cluster"]["generation"]
+            lines = [
+                f"generation: epoch {g['epoch']} ({g['state']}), "
+                f"{g['count']} recoveries",
+                f"proxy: {doc['proxy']['txns_committed']} committed, "
+                f"{doc['proxy']['txns_conflicted']} conflicted, "
+                f"version {doc['proxy']['committed_version']}",
+            ]
+            for i, r in enumerate(doc["resolvers"]):
+                lines.append(
+                    f"resolver {i}: {r['txns']} txns, {r['conflicts']} conflicts"
+                )
+            for s in doc["storage"]:
+                lines.append(
+                    f"storage {s['tag']}: {s['keys']} keys, v{s['version']}"
+                )
+            return "\n".join(lines)
+        if cmd == "processes":
+            return "\n".join(
+                f"{p.name:28s} {addr} {'up' if p.alive else 'DOWN'}"
+                for addr, p in c.net.processes.items()
+            )
+        if cmd == "kill":
+            for p in c.net.processes.values():
+                if p.name == args[0]:
+                    p.kill()
+                    # let the failure monitor notice and recover
+                    c.run_until(c.loop.delay(8.0), deadline=c.loop.now() + 60)
+                    return f"killed {args[0]}; epoch now {c.controller.epoch}"
+            return f"no such process: {args[0]}"
+        return f"unknown command: {cmd} (try help)"
+
+    def repl(self, stdin=None, stdout=None) -> None:
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        interactive = stdin.isatty()
+        while True:
+            if interactive:
+                stdout.write("fdb-tpu> ")
+                stdout.flush()
+            line = stdin.readline()
+            if not line:
+                break
+            for piece in line.split(";"):
+                piece = piece.strip()
+                if piece in ("exit", "quit"):
+                    return
+                if piece:
+                    try:
+                        stdout.write(self.one_command(piece) + "\n")
+                    except Exception as e:  # noqa: BLE001 — REPL resilience
+                        stdout.write(f"ERROR: {e!r}\n")
+
+
+def main() -> None:
+    Cli().repl()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Admin CLI: command surface incl. chaos-driven recovery."""
+
+import io
+
+from foundationdb_tpu.tools.cli import Cli
+
+
+def test_cli_commands_and_kill_recovery():
+    cli = Cli(seed=51, n_storage_shards=2)
+    assert "committed" in cli.one_command("set k1 v1")
+    assert cli.one_command("get k1") == repr(b"v1")
+    assert cli.one_command("get nothing") == "<missing>"
+    cli.one_command("set k2 v2")
+    rng = cli.one_command("getrange k k3")
+    assert "k1" in rng and "k2" in rng
+    assert "committed" in cli.one_command("clear k1")
+    assert cli.one_command("get k1") == "<missing>"
+    status = cli.one_command("status")
+    assert "epoch 1" in status and "committed" in status
+
+    # chaos: kill the proxy by name, expect a recovery and working cluster
+    procs = cli.one_command("processes")
+    proxy_name = next(l.split()[0] for l in procs.splitlines() if l.startswith("proxy"))
+    out = cli.one_command(f"kill {proxy_name}")
+    assert "epoch now 2" in out
+    assert "committed" in cli.one_command("set after-kill yes")
+    assert cli.one_command("get after-kill") == repr(b"yes")
+    cli.cluster.stop()
+
+
+def test_cli_scriptable_repl():
+    cli = Cli(seed=52)
+    out = io.StringIO()
+    cli.repl(stdin=io.StringIO("set a 1; get a\nexit\n"), stdout=out)
+    text = out.getvalue()
+    assert "committed" in text and repr(b"1") in text
+    cli.cluster.stop()
