@@ -164,7 +164,7 @@ class ClusterController:
 
             self.generation = gen
             self._set_state(RecoveryState.ACCEPTING_COMMITS)
-            self._rewire(gen)
+            self._rewire(gen, recovery_version if not first else None)
             self._set_state(RecoveryState.FULLY_RECOVERED)
         finally:
             self._recovering = False
@@ -249,7 +249,8 @@ class ClusterController:
             add_ping(p)
             tlogs.append(
                 TLog(p, self.loop, start_version=recovery_version + 1_000_000,
-                     initial_tags=tlog_seeds[i])
+                     initial_tags=tlog_seeds[i],
+                     known_committed=recovery_version)
             )
 
         resolvers: list[Resolver] = []
@@ -286,18 +287,21 @@ class ClusterController:
             start_version=recovery_version + 1_000_000,
         )
         proxy.ratekeeper = self.ratekeeper
+        proxy.on_commit_failure = self._on_proxy_failure
         return GenerationRoles(
             self.epoch, sequencer, proxy, resolvers, tlogs, procs, ping_tasks
         )
 
-    def _rewire(self, gen: GenerationRoles) -> None:
+    def _rewire(self, gen: GenerationRoles, recovery_version: Version | None = None) -> None:
         """Point storage servers and every registered client view at the new
-        generation (the MonitorLeader push)."""
+        generation (the MonitorLeader push), rolling storage back past the
+        recovery version (phantom versions of UNKNOWN txns must evaporate)."""
         for ss in self.storage:
             tlog = gen.tlogs[self._tag_tlogs(ss.tag)[0]]
             ss.set_tlog_source(
                 RequestStreamRef(self.net, ss.process, tlog.peek_stream.endpoint),
                 RequestStreamRef(self.net, ss.process, tlog.pop_stream.endpoint),
+                recovery_version=recovery_version,
             )
         for view in self.views:
             self._fill_view(view)
@@ -326,6 +330,25 @@ class ClusterController:
         self._fill_view(view)
         self.views.append(view)
         return view
+
+    def _on_proxy_failure(self, proxy, exc) -> None:
+        """A proxy exhausted its commit-path retry budget (e.g. a partition
+        between proxy and resolver that heartbeats can't see): its assigned
+        versions may be chain holes, so the generation must end."""
+        gen = self.generation
+        if gen is None or proxy is not gen.proxy or self._recovering:
+            return
+        self.trace.trace(
+            "ProxyCommitPathFailure", Error=repr(exc), Epoch=self.epoch
+        )
+
+        async def kick() -> None:
+            try:
+                await self._recover()
+            except Exception as e:  # noqa: BLE001 — monitor retries later
+                self.trace.trace("MasterRecoveryError", Error=repr(e), Epoch=self.epoch)
+
+        self.loop.spawn(kick(), TaskPriority.COORDINATION, "cc-proxy-failure")
 
     # -- failure monitoring -------------------------------------------------
     async def _monitor(self) -> None:

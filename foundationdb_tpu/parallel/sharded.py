@@ -18,8 +18,9 @@ the check SPMD:
     partition become padding
   - each device runs the identical single-partition kernel
     (conflict/device.py resolve_core) on its clipped view and local state
-  - verdicts merge with lax.pmin over the axis (CONFLICT=0 < COMMITTED=1 <
-    TOO_OLD=2, same min-combine as the proxy) — ONE collective per batch,
+  - verdicts merge with lax.pmin over the axis (CONFLICT=0 < TOO_OLD=1 <
+    COMMITTED=2, matching the reference enum ConflictSet.h:36-40 — the
+    min-combine's load-bearing ordering) — ONE collective per batch,
     riding ICI.
 
 State stays resident per device (the partition's step function), so the

@@ -107,6 +107,10 @@ class CommitProxy:
         self.tag_to_tlogs = tag_to_tlogs or {t: [0] for t in storage_tags.members}
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
+        self.name = process.name
+        self.on_commit_failure = None  # controller hook: escalate to recovery
+        self._req_num = 0
+        self._failed = False
         self._grv_tokens = 10.0
         self._grv_refill_at = loop.now()
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
@@ -145,26 +149,61 @@ class CommitProxy:
             if self._pending or idle >= self.knobs.COMMIT_BATCH_INTERVAL_MAX:
                 batch, self._pending = self._pending, []
                 idle = 0.0
-                self.loop.spawn(self._commit_batch(batch), TaskPriority.PROXY_COMMIT)
+                # cap batch size (the reference's COMMIT_BATCH_MAX_COUNT):
+                # oversized ticks split into sequential pipelined batches
+                cap = max(self.knobs.COMMIT_BATCH_MAX_COUNT, 1)
+                for i in range(0, max(len(batch), 1), cap):
+                    self.loop.spawn(
+                        self._commit_batch(batch[i : i + cap]),
+                        TaskPriority.PROXY_COMMIT,
+                    )
             else:
                 idle += self._batch_interval
 
     # -- phases 2-5 ----------------------------------------------------------
+    async def _retry_reply(self, ref: RequestStreamRef, payload, deadline: float):
+        """get_reply with bounded retries: every commit-path RPC is
+        idempotent under retry (sequencer dedups request_num, resolvers
+        abort-all on duplicate versions, TLogs re-ack), so a dropped packet
+        costs a retry instead of a permanently wedged version chain."""
+        attempt = 0
+        while True:
+            try:
+                return await ref.get_reply(payload, timeout=1.0)
+            except TimedOut:
+                attempt += 1
+                if self._failed or self.loop.now() >= deadline:
+                    raise
+                await self.loop.delay(
+                    min(0.05 * attempt, 0.5), TaskPriority.PROXY_COMMIT
+                )
+
     async def _commit_batch(self, batch: list[_PendingCommit]) -> None:
         try:
             await self._commit_batch_inner(batch)
-        except TimedOut:
-            # a downstream role (sequencer/resolver/tlog) is unreachable:
-            # this generation is ending.  The txns may or may not land once
-            # recovery replays surviving logs — reply UNKNOWN, the client's
-            # commit_unknown_result path (NativeAPI.actor.cpp:2482-2502)
+        except Exception as e:  # noqa: BLE001 — containment: ANY commit-path
+            # failure (not just TimedOut) must answer the clients and, since
+            # an assigned version may now be a hole in the prev->version
+            # chain, escalate to recovery rather than wedge the pipeline.
+            # The txns may or may not land once recovery replays surviving
+            # logs — reply UNKNOWN, the client's commit_unknown_result path
+            # (NativeAPI.actor.cpp:2482-2502).
             for pc in batch:
                 pc.reply_cb.reply(CommitReply(CommitResult.UNKNOWN))
+            if not self._failed:
+                self._failed = True
+                self.counters.counter("commit_path_failures").add(1)
+                if self.on_commit_failure is not None:
+                    self.on_commit_failure(self, e)
 
     async def _commit_batch_inner(self, batch: list[_PendingCommit]) -> None:
         self.c_batches.add(1)
-        gv: GetCommitVersionReply = await self.sequencer.get_reply(
-            GetCommitVersionRequest(requesting_proxy="proxy"), timeout=2.0
+        deadline = self.loop.now() + self.knobs.COMMIT_PATH_GIVEUP
+        self._req_num += 1
+        gv: GetCommitVersionReply = await self._retry_reply(
+            self.sequencer,
+            GetCommitVersionRequest(self.name, self._req_num),
+            deadline,
         )
         prev_v, version = gv.prev_version, gv.version
 
@@ -187,9 +226,13 @@ class CommitProxy:
                 per_res[r].append(TxInfo(t.read_snapshot, rr, wr))
         replies = await wait_all(
             [
-                self.resolvers[r].get_reply(
-                    ResolveTransactionBatchRequest(prev_v, version, per_res[r]),
-                    timeout=2.0,
+                self.loop.spawn(
+                    self._retry_reply(
+                        self.resolvers[r],
+                        ResolveTransactionBatchRequest(prev_v, version, per_res[r]),
+                        deadline,
+                    ),
+                    TaskPriority.PROXY_COMMIT,
                 )
                 for r in range(n_res)
             ]
@@ -221,7 +264,19 @@ class CommitProxy:
                 per_tlog[idx][tag] = muts
         await wait_all(
             [
-                t.get_reply(TLogCommitRequest(prev_v, version, per_tlog[i]), timeout=2.0)
+                self.loop.spawn(
+                    self._retry_reply(
+                        t,
+                        TLogCommitRequest(
+                            prev_v,
+                            version,
+                            per_tlog[i],
+                            known_committed=self.committed_version.get(),
+                        ),
+                        deadline,
+                    ),
+                    TaskPriority.PROXY_COMMIT,
+                )
                 for i, t in enumerate(self.tlogs)
             ]
         )

@@ -50,6 +50,10 @@ class Resolver:
         self.c_batches = self.counters.counter("batches")
         self.c_txns = self.counters.counter("txns")
         self.c_conflicts = self.counters.counter("conflicts")
+        # recent batch outcomes so a proxy retry of an already-resolved
+        # version re-receives its real verdicts (the reference caches recent
+        # replies; abort-all would turn every retried batch into aborts)
+        self._reply_cache: dict[Version, list[int]] = {}
         self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
 
     async def _serve(self) -> None:
@@ -63,12 +67,15 @@ class Resolver:
         r: ResolveTransactionBatchRequest = req.payload
         await self.version.when_at_least(r.prev_version)
         if self.version.get() >= r.version:
-            # duplicate delivery (proxy retry after timeout): the reference
-            # caches recent outcomes; we conservatively abort-all so the
+            # duplicate delivery (proxy retry after timeout): re-reply the
+            # cached verdicts; if evicted, conservatively abort-all so the
             # client retries (safe: committed=false never loses data)
+            cached = self._reply_cache.get(r.version)
             req.reply(
                 ResolveTransactionBatchReply(
-                    committed=[int(Verdict.CONFLICT)] * len(r.transactions)
+                    committed=cached
+                    if cached is not None
+                    else [int(Verdict.CONFLICT)] * len(r.transactions)
                 )
             )
             return
@@ -81,8 +88,20 @@ class Resolver:
         window = self.knobs.mvcc_window_versions
         if r.version > window:
             self.cs.remove_before(r.version - window)
+            # insertion order is version order: evict from the front only,
+            # O(evicted) not O(cache size) per batch
+            cutoff = r.version - window
+            stale = []
+            for v in self._reply_cache:
+                if v >= cutoff:
+                    break
+                stale.append(v)
+            for v in stale:
+                del self._reply_cache[v]
+        committed = [int(v) for v in verdicts]
+        self._reply_cache[r.version] = committed
         self.version.set(r.version)
-        req.reply(ResolveTransactionBatchReply(committed=[int(v) for v in verdicts]))
+        req.reply(ResolveTransactionBatchReply(committed=committed))
 
     def stop(self) -> None:
         self._task.cancel()

@@ -38,6 +38,14 @@ class NotifiedVersion:
         for want, p in sorted(ready, key=lambda w: w[0]):
             p.send(v)
 
+    def rollback(self, v: Version) -> None:
+        """Move the value DOWN (recovery-only: storage discards versions
+        above the recovery version).  Waiters above v keep waiting — the new
+        generation's versions jump past anything previously observed, so
+        they resume once real commits arrive."""
+        if v < self._value:
+            self._value = v
+
     def when_at_least(self, v: Version) -> Future:
         if self._value >= v:
             p = Promise()
@@ -62,6 +70,14 @@ class Sequencer:
         self._epoch_start = loop.now()
         self._version_at_epoch = start_version
         self.stream = RequestStream(process, self.WLT)
+        # per-proxy reply cache keyed by request_num: a retried request_num
+        # re-receives its own (prev, version) pair instead of burning a fresh
+        # version (the reference's per-proxy requestNum dedup in getVersion).
+        # Batches pipeline, so MANY request_nums can be in flight at once —
+        # a single-entry cache would hand an old retry a newer batch's
+        # versions (two batches sharing one commit version = lost writes).
+        self._replies: dict[str, dict[int, GetCommitVersionReply]] = {}
+        self._cache_cap = 4096
         self._task = loop.spawn(self._serve(), TaskPriority.GET_LIVE_VERSION, "sequencer")
 
     def _next_version(self) -> Version:
@@ -75,10 +91,24 @@ class Sequencer:
     async def _serve(self) -> None:
         while True:
             req = await self.stream.next()
-            assert isinstance(req.payload, GetCommitVersionRequest)
+            r = req.payload
+            assert isinstance(r, GetCommitVersionRequest)
+            cache = self._replies.setdefault(r.requesting_proxy, {})
+            cached = cache.get(r.request_num)
+            if cached is not None:
+                req.reply(cached)  # duplicate (proxy retry): same versions
+                continue
+            if cache and r.request_num < next(reversed(cache)):
+                # stale retry of an evicted request: assigning a fresh
+                # version would duplicate the original; stay silent — the
+                # proxy gives up and escalates to recovery
+                continue
             v = self._next_version()
             reply = GetCommitVersionReply(prev_version=self._last_assigned, version=v)
             self._last_assigned = v
+            cache[r.request_num] = reply
+            while len(cache) > self._cache_cap:
+                del cache[next(iter(cache))]
             req.reply(reply)
 
     def stop(self) -> None:

@@ -121,17 +121,27 @@ class VersionedOverlay:
         return (k for k in self._chains if begin <= k < end)
 
     def forget_before(self, version: Version, base_set, base_clear) -> None:
-        """Flush entries <= version into the base and drop old history."""
+        """Flush entries <= version into the base and drop old history.
+
+        Replay order matters: range-clears go into the base FIRST, then
+        per-key newest values.  A set at a version later than a covering
+        clear must survive the flush; per-key ordering within the window is
+        already encoded by the chain (apply() interleaves _CLEARED
+        tombstones in version/mutation order), so the last flushable chain
+        entry is the correct final state — no extra clear-wins check.
+        """
+        for cv, b, e in self._clears:
+            if cv <= version:
+                base_clear(b, e)
+        self._clears = [c for c in self._clears if c[0] > version]
+        self._flush_chains(version, base_set, base_clear)
+        self.oldest = max(self.oldest, version)
+
+    def _flush_chains(self, version: Version, base_set, base_clear) -> None:
         for key, chain in list(self._chains.items()):
             flushable = [(v, val) for v, val in chain if v <= version]
             if flushable:
                 v, val = flushable[-1]
-                # clears newer than this set (but <= version) win over it
-                if any(
-                    cv <= version and cv >= v and b <= key < e
-                    for cv, b, e in self._clears
-                ):
-                    val = _CLEARED
                 if val is _CLEARED:
                     base_clear(key, key + b"\x00")
                 else:
@@ -141,11 +151,20 @@ class VersionedOverlay:
                     self._chains[key] = remaining
                 else:
                     del self._chains[key]
-        for cv, b, e in self._clears:
-            if cv <= version:
-                base_clear(b, e)
-        self._clears = [c for c in self._clears if c[0] > version]
-        self.oldest = max(self.oldest, version)
+
+    def rollback_to(self, version: Version) -> None:
+        """Discard every entry/clear with version > version (recovery: a
+        storage server may have applied mutations a failed TLog replica
+        served but that fall above the recovery version — phantom,
+        UNKNOWN-result transactions that must not survive; the reference
+        rolls storage back past the recovery version)."""
+        for key, chain in list(self._chains.items()):
+            kept = [(v, val) for v, val in chain if v <= version]
+            if kept:
+                self._chains[key] = kept
+            else:
+                del self._chains[key]
+        self._clears = [c for c in self._clears if c[0] <= version]
 
 
 class StorageServer:
@@ -175,6 +194,13 @@ class StorageServer:
         self.version = NotifiedVersion(start_version)   # newest applied
         self.durable_version = start_version
         self._fetched = start_version
+        # durability watermark: highest version known committed cluster-wide
+        # (proxy -> TLog -> peek reply).  Versions above it may be rolled
+        # back by a recovery, so they must never reach the durable base.
+        self.known_committed = start_version
+        # bumped by set_tlog_source: a peek reply awaited across a rollback
+        # must be discarded, not applied (it may carry phantom versions)
+        self._pull_epoch = 0
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES)
         self.watch_stream = RequestStream(process, self.WLT_WATCH)
@@ -193,6 +219,7 @@ class StorageServer:
             if self.tlog is None:  # no log system yet (pre-first-recovery)
                 await self.loop.delay(0.05, TaskPriority.STORAGE_SERVER)
                 continue
+            epoch = self._pull_epoch
             try:
                 reply = await self.tlog.get_reply(
                     TLogPeekRequest(self.tag, self._fetched + 1), timeout=1.0
@@ -202,6 +229,9 @@ class StorageServer:
                 # and retry — the pull loop must survive transient faults
                 await self.loop.delay(0.1, TaskPriority.STORAGE_SERVER)
                 continue
+            if epoch != self._pull_epoch:
+                continue  # rolled back while awaiting: stale reply, drop it
+            self.known_committed = max(self.known_committed, reply.known_committed)
             for version, muts in reply.entries:
                 if version <= self.version.get():
                     continue
@@ -223,7 +253,10 @@ class StorageServer:
             await self.loop.delay(self.knobs.STORAGE_DURABILITY_LAG, TaskPriority.STORAGE_SERVER)
             target = self.version.get()
             window = self.knobs.mvcc_window_versions
-            flush_to = target - window
+            # never make durable past the cluster-wide committed watermark:
+            # versions above it can be rolled back by recovery, and the base
+            # store cannot un-flush (knownCommittedVersion bound)
+            flush_to = min(target - window, self.known_committed)
             if flush_to > self.durable_version:
                 self.overlay.forget_before(
                     flush_to, self.store.set, self.store.clear_range
@@ -316,12 +349,35 @@ class StorageServer:
         more = len(out) > r.limit
         req.reply(GetKeyValuesReply(out[: r.limit], more))
 
-    def set_tlog_source(self, peek_ref: RequestStreamRef, pop_ref: RequestStreamRef) -> None:
+    def set_tlog_source(
+        self,
+        peek_ref: RequestStreamRef,
+        pop_ref: RequestStreamRef,
+        recovery_version: Version | None = None,
+    ) -> None:
         """Re-point at a new TLog generation (recovery: storage servers
         rejoin the new log system by tag — SURVEY §5).  The pull loop reads
-        these refs each iteration, so the switch takes effect immediately."""
+        these refs each iteration, so the switch takes effect immediately.
+
+        When a recovery version is given, roll back any applied state above
+        it: a dead TLog replica may have served versions that were never
+        acked on every replica, and those are UNKNOWN-result — they must
+        evaporate with the old generation."""
         self.tlog = peek_ref
         self.tlog_pop = pop_ref
+        self._pull_epoch += 1  # in-flight peek replies are now stale
+        if recovery_version is not None:
+            # everything <= recovery_version is committed cluster-wide
+            self.known_committed = max(self.known_committed, recovery_version)
+        if recovery_version is not None and self.version.get() > recovery_version:
+            # unreachable unless the knownCommittedVersion bound was violated
+            assert self.durable_version <= recovery_version, (
+                "storage made phantom versions durable: "
+                f"{self.durable_version} > {recovery_version}"
+            )
+            self.overlay.rollback_to(recovery_version)
+            self.version.rollback(recovery_version)
+            self._fetched = recovery_version
 
     def stop(self) -> None:
         for t in self._tasks:

@@ -38,11 +38,15 @@ class TLog:
 
     def __init__(self, process: SimProcess, loop: EventLoop,
                  start_version: Version = 0, sync_delay: float = 0.0005,
-                 initial_tags: dict | None = None) -> None:
+                 initial_tags: dict | None = None,
+                 known_committed: Version = 0) -> None:
         self.loop = loop
         self.process = process
         self.sync_delay = sync_delay
         self.version = NotifiedVersion(start_version)
+        # highest version known committed cluster-wide (acked by EVERY TLog
+        # replica) — storage durability must never pass it
+        self.known_committed = known_committed
         self.locked = False
         # per-tag: sorted list of (version, [Mutation]); popped prefix removed
         self._tags: dict[str, list[tuple[Version, list]]] = dict(initial_tags or {})
@@ -75,11 +79,17 @@ class TLog:
             # duplicate push (proxy retry): already logged, ack again
             req.reply(r.version)
             return
-        for tag, muts in r.mutations_by_tag.items():
-            self._tags.setdefault(tag, []).append((r.version, muts))
+        # Sync BEFORE publishing: peek/lock must never serve data that was
+        # not acked durable, or storage applies versions above the eventual
+        # recovery version (phantom mutations of UNKNOWN-result txns).
         if self.sync_delay:
             await self.loop.delay(self.sync_delay, TaskPriority.TLOG_COMMIT)
+        if self.locked:
+            return  # locked mid-sync: unacked data is lost with the epoch
+        for tag, muts in r.mutations_by_tag.items():
+            self._tags.setdefault(tag, []).append((r.version, muts))
         self.version.set(r.version)
+        self.known_committed = max(self.known_committed, r.known_committed)
         req.reply(r.version)
 
     # -- peek --------------------------------------------------------------
@@ -93,7 +103,13 @@ class TLog:
             truncated = i + 1000 < len(q)
             # on truncation, end_version must not skip unfetched entries
             end = entries[-1][0] + 1 if truncated else self.version.get() + 1
-            req.reply(TLogPeekReply(entries=entries, end_version=end))
+            req.reply(
+                TLogPeekReply(
+                    entries=entries,
+                    end_version=end,
+                    known_committed=self.known_committed,
+                )
+            )
 
     # -- pop ---------------------------------------------------------------
     async def _serve_pop(self) -> None:

@@ -121,7 +121,12 @@ class CommitReply:
 
 @dataclasses.dataclass
 class GetCommitVersionRequest:
+    """Version-assignment request; request_num makes retries idempotent
+    (masterserver.actor.cpp getVersion dedups per-proxy request numbers so a
+    lost reply never strands an assigned version as a chain hole)."""
+
     requesting_proxy: str
+    request_num: int = 0
 
 
 @dataclasses.dataclass
@@ -155,6 +160,11 @@ class TLogCommitRequest:
     prev_version: Version
     version: Version
     mutations_by_tag: dict[str, list[Mutation]]
+    # proxy's committed version at push time (the reference's
+    # knownCommittedVersion): flows proxy -> TLog -> storage so storage
+    # never makes durable a version that could sit above a future recovery
+    # version (TLogServer.actor.cpp knownCommittedVersion)
+    known_committed: Version = 0
 
 
 @dataclasses.dataclass
@@ -167,6 +177,7 @@ class TLogPeekRequest:
 class TLogPeekReply:
     entries: list[tuple[Version, list[Mutation]]]
     end_version: Version    # caller may peek again from here
+    known_committed: Version = 0  # durability bound for the puller
 
 
 @dataclasses.dataclass

@@ -54,6 +54,10 @@ class CoreKnobs(Knobs):
         # storage
         self.init("STORAGE_DURABILITY_LAG", 0.05)
         self.init("DESIRED_TEAM_SIZE", 3)
+        # commit-path retry budget: past this, the proxy reports UNKNOWN and
+        # escalates to recovery (longer than FAILURE_TIMEOUT so dead-role
+        # heartbeat detection normally wins; this covers proxy-only partitions)
+        self.init("COMMIT_PATH_GIVEUP", 4.0)
         # failure detection
         self.init("FAILURE_TIMEOUT", 1.0 if r is None else 0.5 + r.random())
         self.init("HEARTBEAT_INTERVAL", 0.2)

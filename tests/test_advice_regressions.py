@@ -1,0 +1,234 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. VersionedOverlay.forget_before replayed clears into the base AFTER newer
+   per-key sets, silently deleting committed data (clear@v1 + set@v2,
+   flush@5 -> base lost the set).
+2. TLog published mutations to its tag queues before the sync delay, so
+   peek/lock could serve unacked data; with a replica loss this left storage
+   applied above the recovery version (phantom UNKNOWN-result mutations).
+   Storage now also rolls back past the recovery version on rewire.
+3. A single dropped commit-path packet left the sequencer-assigned version
+   as a permanent hole in the prev->version chain, wedging the pipeline
+   forever.  The sequencer now dedups retried request_nums and the proxy
+   retries idempotently.
+"""
+
+from foundationdb_tpu.roles.storage import MemoryKeyValueStore, VersionedOverlay
+from foundationdb_tpu.roles.types import Mutation, MutationType
+
+
+def mk_set(k, v):
+    return Mutation(MutationType.SET_VALUE, k, v)
+
+
+def mk_clear(b, e):
+    return Mutation(MutationType.CLEAR_RANGE, b, e)
+
+
+class TestForgetBefore:
+    def test_set_after_clear_survives_flush(self):
+        """ADVICE high #1 repro: clear [a,z)@1 + set b@2, flush@5 -> get(b)
+        must return the set value from the base, not None."""
+        base = MemoryKeyValueStore()
+        base.set(b"a", b"old-a")
+        base.set(b"b", b"old-b")
+        ov = VersionedOverlay()
+        ov.apply(1, mk_clear(b"a", b"z"), base.get)
+        ov.apply(2, mk_set(b"b", b"new-b"), base.get)
+        assert ov.get(b"b", 3, base.get) == b"new-b"
+        ov.forget_before(5, base.set, base.clear_range)
+        # after the window ages out, reads come straight from the base
+        assert ov.get(b"b", 100, base.get) == b"new-b"
+        assert ov.get(b"a", 100, base.get) is None
+
+    def test_same_version_clear_then_set(self):
+        """A set AFTER a clear in mutation order at the same version wins
+        (chain position, not version comparison)."""
+        base = MemoryKeyValueStore()
+        base.set(b"k", b"old")
+        ov = VersionedOverlay()
+        ov.apply(3, mk_clear(b"a", b"z"), base.get)
+        ov.apply(3, mk_set(b"k", b"new"), base.get)
+        assert ov.get(b"k", 3, base.get) == b"new"
+        ov.forget_before(4, base.set, base.clear_range)
+        assert ov.get(b"k", 100, base.get) == b"new"
+
+    def test_set_then_clear_is_cleared(self):
+        base = MemoryKeyValueStore()
+        ov = VersionedOverlay()
+        ov.apply(1, mk_set(b"k", b"v"), base.get)
+        ov.apply(2, mk_clear(b"a", b"z"), base.get)
+        ov.forget_before(3, base.set, base.clear_range)
+        assert ov.get(b"k", 100, base.get) is None
+
+    def test_rollback_to_discards_phantoms(self):
+        base = MemoryKeyValueStore()
+        ov = VersionedOverlay()
+        ov.apply(1, mk_set(b"a", b"committed"), base.get)
+        ov.apply(5, mk_set(b"a", b"phantom"), base.get)
+        ov.apply(6, mk_clear(b"b", b"c"), base.get)
+        ov.rollback_to(3)
+        assert ov.get(b"a", 10, base.get) == b"committed"
+        assert ov._clears == []
+
+
+class TestTLogUnackedInvisible:
+    def _mk(self, sync_delay):
+        from foundationdb_tpu.roles.tlog import TLog
+        from foundationdb_tpu.rpc.network import SimNetwork
+        from foundationdb_tpu.rpc.stream import RequestStreamRef
+        from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+
+        loop = EventLoop()
+        rng = DeterministicRandom(7)
+        net = SimNetwork(loop, rng)
+        tproc = net.create_process("tlog")
+        cproc = net.create_process("client")
+        tlog = TLog(tproc, loop, sync_delay=sync_delay)
+        return loop, net, cproc, tlog
+
+    def test_peek_never_serves_unacked(self):
+        """During the sync delay the commit is not durable: peek must not
+        serve it, and lock must not include it."""
+        from foundationdb_tpu.roles.types import (
+            TLogCommitRequest,
+            TLogLockRequest,
+            TLogPeekRequest,
+        )
+        from foundationdb_tpu.rpc.stream import RequestStreamRef
+
+        loop, net, cproc, tlog = self._mk(sync_delay=0.05)
+
+        results = {}
+
+        async def committer():
+            ref = RequestStreamRef(net, cproc, tlog.commit_stream.endpoint)
+            m = {"ss-0": [mk_set(b"k", b"v")]}
+            results["ack"] = await ref.get_reply(TLogCommitRequest(0, 10, m))
+
+        async def peeker():
+            # wait until the commit is mid-sync, then peek
+            await loop.delay(0.02)
+            ref = RequestStreamRef(net, cproc, tlog.peek_stream.endpoint)
+            rep = await ref.get_reply(TLogPeekRequest("ss-0", 1))
+            results["mid_sync_entries"] = list(rep.entries)
+            results["mid_sync_end"] = rep.end_version
+            lref = RequestStreamRef(net, cproc, tlog.lock_stream.endpoint)
+            # lock fires after sync completes; check the final state too
+            await loop.delay(0.1)
+            rep2 = await ref.get_reply(TLogPeekRequest("ss-0", 1))
+            results["after_entries"] = list(rep2.entries)
+            results["lock"] = await lref.get_reply(TLogLockRequest())
+
+        t1 = loop.spawn(committer())
+        t2 = loop.spawn(peeker())
+        loop.run_until(t2, deadline=10.0)
+        assert results["mid_sync_entries"] == []
+        assert results["mid_sync_end"] <= 1  # no version beyond acked
+        assert results["ack"] == 10
+        assert [v for v, _ in results["after_entries"]] == [10]
+        assert results["lock"].end_version == 10
+
+    def test_lock_mid_sync_discards_unacked(self):
+        """A lock arriving during the sync delay ends the epoch: the unacked
+        commit must never be acked nor appear in the locked tag data."""
+        from foundationdb_tpu.roles.types import (
+            TLogCommitRequest,
+            TLogLockRequest,
+        )
+        from foundationdb_tpu.rpc.stream import RequestStreamRef
+        from foundationdb_tpu.runtime.core import TimedOut
+
+        loop, net, cproc, tlog = self._mk(sync_delay=0.05)
+        results = {}
+
+        async def committer():
+            ref = RequestStreamRef(net, cproc, tlog.commit_stream.endpoint)
+            m = {"ss-0": [mk_set(b"k", b"v")]}
+            try:
+                results["ack"] = await ref.get_reply(
+                    TLogCommitRequest(0, 10, m), timeout=1.0
+                )
+            except TimedOut:
+                results["ack"] = "timed-out"
+
+        async def locker():
+            await loop.delay(0.02)  # mid-sync
+            lref = RequestStreamRef(net, cproc, tlog.lock_stream.endpoint)
+            results["lock"] = await lref.get_reply(TLogLockRequest())
+
+        async def settle():
+            await loop.delay(2.0)
+
+        loop.spawn(committer())
+        t = loop.spawn(locker())
+        loop.run_until(t, deadline=10.0)
+        loop.run_until(loop.spawn(settle()), deadline=10.0)
+        assert results["ack"] == "timed-out"
+        assert results["lock"].end_version == 0
+        assert results["lock"].tags.get("ss-0", []) == []
+
+
+class TestSequencerDedup:
+    def test_retried_request_num_reuses_version(self):
+        from foundationdb_tpu.roles.sequencer import Sequencer
+        from foundationdb_tpu.roles.types import GetCommitVersionRequest
+        from foundationdb_tpu.rpc.network import SimNetwork
+        from foundationdb_tpu.rpc.stream import RequestStreamRef
+        from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+        from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+        loop = EventLoop()
+        net = SimNetwork(loop, DeterministicRandom(9))
+        sp = net.create_process("seq")
+        cp = net.create_process("proxy")
+        seq = Sequencer(sp, loop, CoreKnobs())
+        ref = RequestStreamRef(net, cp, seq.stream.endpoint)
+
+        async def main():
+            a = await ref.get_reply(GetCommitVersionRequest("p1", 1))
+            dup = await ref.get_reply(GetCommitVersionRequest("p1", 1))
+            b = await ref.get_reply(GetCommitVersionRequest("p1", 2))
+            return a, dup, b
+
+        a, dup, b = loop.run_until(loop.spawn(main()), deadline=10.0)
+        assert (a.prev_version, a.version) == (dup.prev_version, dup.version)
+        assert b.prev_version == a.version  # chain continues, no hole
+        assert b.version > a.version
+
+
+class TestCommitPathRetry:
+    def test_dropped_commit_packet_does_not_wedge(self):
+        """ADVICE medium repro: clog the proxy<->resolver pair long enough
+        for one RPC timeout; the retried batch must land and later commits
+        must keep flowing (previously the pipeline wedged forever)."""
+        from foundationdb_tpu.cluster import SimCluster
+
+        c = SimCluster(seed=77, n_resolvers=2)
+        db = c.database()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set(b"before", b"1")
+            await tr.commit()
+            # clog proxy <-> resolver0 past the RPC timeout (1s) but well
+            # under the proxy's give-up budget
+            proxy_addr = c.proxy.commit_stream.endpoint.address
+            res_addr = c.resolvers[0].stream.endpoint.address
+            c.net.clog_pair(proxy_addr, res_addr, 1.5)
+            tr = db.create_transaction()
+            tr.set(b"during", b"2")
+            await tr.commit()
+            tr = db.create_transaction()
+            tr.set(b"after", b"3")
+            await tr.commit()
+            tr2 = db.create_transaction()
+            return [
+                await tr2.get(b"before"),
+                await tr2.get(b"during"),
+                await tr2.get(b"after"),
+            ]
+
+        got = c.run_until(c.loop.spawn(main()), 120)
+        assert got == [b"1", b"2", b"3"]
+        c.stop()
