@@ -84,6 +84,8 @@ class ClusterController:
         resolver_splits: list[bytes],
         n_tlogs: int = 2,
         cstate=None,  # CoordinatedState or None (tests without coordinators)
+        fs=None,      # SimFilesystem: TLogs become disk-backed
+        restart: bool = False,  # bootstrap generation 1 from on-disk TLogs
     ) -> None:
         self.loop = loop
         self.net = net
@@ -96,6 +98,8 @@ class ClusterController:
         self.make_cs = conflict_backend
         self.n_tlogs = n_tlogs
         self.cstate = cstate
+        self.fs = fs
+        self.restart = restart
         self.epoch = 0
         self.recoveries = 0
         self.ratekeeper = None  # set by the cluster after construction
@@ -131,16 +135,29 @@ class ClusterController:
             return
         self._recovering = True
         try:
+            self._set_state(RecoveryState.READING_CSTATE)
+            old = self.generation
+            prev_state = None
+            if self.cstate is not None:
+                prev_state, _gen = await self.cstate.read()
+            if prev_state is not None:
+                self.epoch = max(self.epoch, prev_state["epoch"])
             self.epoch += 1
             if not first:
                 self.recoveries += 1
-            self._set_state(RecoveryState.READING_CSTATE)
-            old = self.generation
 
             # LOCKING_CSTATE: stop the old generation's TLogs, learn the
             # recovery version and surviving tag data
             self._set_state(RecoveryState.LOCKING_CSTATE)
-            recovery_version, tag_data = await self._lock_old_tlogs(old)
+            if old is None and self.restart and prev_state is not None:
+                # whole-cluster restart: the previous epoch's TLogs exist
+                # only as files; replay their synced logs in place of lock
+                # replies (SimulatedCluster restartSimulatedSystem analog)
+                recovery_version, tag_data = self._recover_tlogs_from_disk(
+                    prev_state["epoch"]
+                )
+            else:
+                recovery_version, tag_data = await self._lock_old_tlogs(old)
 
             # RECRUITING: fresh pipeline on fresh processes
             self._set_state(RecoveryState.RECRUITING)
@@ -150,17 +167,32 @@ class ClusterController:
                 for t in old.ping_tasks:
                     t.cancel()
             gen = self._recruit(recovery_version, tag_data)
+            # durable-seed barrier: the new TLogs' RESET records (carrying
+            # every surviving committed byte) must be on disk before the
+            # cstate names this epoch — else a power loss between the write
+            # and the first commit sync would lose the seeds with nothing to
+            # fall back to (the old epoch's files are superseded)
+            for t in gen.tlogs:
+                await t.initial_durable()
 
             # WRITING_CSTATE: publish via coordinators (stale CC halts here)
             self._set_state(RecoveryState.WRITING_CSTATE)
             if self.cstate is not None:
                 ok = await self.cstate.write(
-                    {"epoch": self.epoch, "recovery_version": recovery_version}
+                    {"epoch": self.epoch, "recovery_version": recovery_version,
+                     "n_tlogs": self.n_tlogs}
                 )
                 if not ok:
                     for p in gen.processes:
                         p.kill()
                     raise RuntimeError("lost cstate race: a newer master exists")
+            if self.fs is not None:
+                # previous epochs' TLog files are superseded by this epoch's
+                # durable RESETs + the cstate record naming this epoch
+                for i in range(self.n_tlogs):
+                    for path in self.fs.list(f"tlog{i}-e"):
+                        if path != self._tlog_path(i, self.epoch):
+                            self.fs.delete(path)
 
             self.generation = gen
             self._set_state(RecoveryState.ACCEPTING_COMMITS)
@@ -188,7 +220,12 @@ class ClusterController:
         # partially-pushed suffix consistently across tags (the reference's
         # recovery-version rule)
         recovery_version = min(r.end_version for r in alive)
-        # rebuild per-new-tlog tag seeds from surviving replicas
+        return recovery_version, self._merge_tlog_replies(alive, recovery_version)
+
+    def _merge_tlog_replies(
+        self, alive: list[TLogLockReply], recovery_version: Version
+    ) -> list[dict]:
+        """Rebuild per-new-tlog tag seeds from surviving replicas."""
         merged: dict[str, list] = {}
         for r in alive:
             for tag, entries in r.tags.items():
@@ -202,7 +239,35 @@ class ClusterController:
             for idx in self._tag_tlogs(tag):
                 seeds[idx][tag] = list(entries)  # per-replica copy: the new
                 # TLogs append to these lists independently
-        return recovery_version, seeds
+        return seeds
+
+    def _tlog_path(self, slot: int, epoch: int) -> str:
+        return f"tlog{slot}-e{epoch}.dq"
+
+    def _recover_tlogs_from_disk(self, prev_epoch: int):
+        """Whole-cluster restart: rebuild (recovery_version, seeds) from the
+        previous epoch's synced TLog files.  Unsynced suffixes died with the
+        power loss; every acked commit was synced on EVERY replica, so the
+        min over recovered ends keeps all acked data."""
+        from ..storage.diskqueue import DiskQueue
+
+        replies = []
+        for i in range(self.n_tlogs):
+            path = self._tlog_path(i, prev_epoch)
+            if not self.fs.exists(path):
+                replies.append(None)
+                continue
+            dq = DiskQueue(self.fs.open(path, None))
+            end, _kc, tags = TLog.recover_state(dq)
+            replies.append(TLogLockReply(end_version=end, tags=tags))
+        alive = [r for r in replies if r is not None]
+        if len(alive) < self.n_tlogs:
+            # with 2x tag replication, one missing slot is survivable (its
+            # tags exist on the neighbor); zero survivors is not
+            if not alive:
+                raise RuntimeError("no TLog files recovered: data loss")
+        recovery_version = min(r.end_version for r in alive)
+        return recovery_version, self._merge_tlog_replies(alive, recovery_version)
 
     def _tag_tlogs(self, tag: str) -> list[int]:
         """TLog replica set for a tag: primary + next (2x log replication —
@@ -247,10 +312,16 @@ class ClusterController:
             p = self._new_proc(f"tlog{i}")
             procs.append(p)
             add_ping(p)
+            dq = None
+            if self.fs is not None:
+                from ..storage.diskqueue import DiskQueue
+
+                dq = DiskQueue(self.fs.open(self._tlog_path(i, self.epoch), p))
             tlogs.append(
                 TLog(p, self.loop, start_version=recovery_version + 1_000_000,
                      initial_tags=tlog_seeds[i],
-                     known_committed=recovery_version)
+                     known_committed=recovery_version,
+                     disk_queue=dq)
             )
 
         resolvers: list[Resolver] = []

@@ -59,17 +59,25 @@ class WriteRegReply:
 
 
 class Coordinator:
-    """One coordination server: a durable generation register."""
+    """One coordination server: a generation register, disk-backed when a
+    filesystem is given (the reference's OnDemandStore-backed
+    localGenerationReg — registers must survive whole-cluster restarts or
+    recovery cannot find the last log-system epoch)."""
 
     WLT_READ = "wlt:coord_read"
     WLT_WRITE = "wlt:coord_write"
 
-    def __init__(self, process: SimProcess, loop: EventLoop) -> None:
+    def __init__(self, process: SimProcess, loop: EventLoop,
+                 fs=None, path: str | None = None) -> None:
         self.process = process
         self.loop = loop
         self.value: Any = None
         self.write_gen: Generation = GEN_ZERO
         self.promised: Generation = GEN_ZERO
+        self._file = None
+        if fs is not None:
+            self._file = fs.open(path or f"coord-{process.name}.reg", process)
+            self._load()
         self.read_stream = RequestStream(process, self.WLT_READ)
         self.write_stream = RequestStream(process, self.WLT_WRITE)
         self._tasks = [
@@ -77,12 +85,48 @@ class Coordinator:
             loop.spawn(self._serve_write(), TaskPriority.COORDINATION, "coord-write"),
         ]
 
+    # -- durability ---------------------------------------------------------
+    def _load(self) -> None:
+        import json
+
+        from ..storage.diskqueue import DiskQueue
+
+        records = DiskQueue(self._file).recover()
+        if records:
+            doc = json.loads(records[-1])  # last synced write wins
+            self.value = doc["value"]
+            self.write_gen = Generation(*doc["write_gen"])
+            self.promised = Generation(*doc["promised"])
+
+    async def _persist(self) -> None:
+        import json
+
+        from ..storage.diskqueue import DiskQueue
+
+        # append-only (recover() takes the last record): truncating in place
+        # would open a crash window with no durable register at all.  The
+        # file grows only with recoveries/elections — bounded in practice.
+        dq = DiskQueue(self._file)
+        dq.push(
+            json.dumps(
+                {
+                    "value": self.value,
+                    "write_gen": [self.write_gen.number, self.write_gen.owner],
+                    "promised": [self.promised.number, self.promised.owner],
+                },
+                sort_keys=True,
+            ).encode()
+        )
+        await dq.sync()
+
     async def _serve_read(self) -> None:
         while True:
             req = await self.read_stream.next()
             r: ReadRegRequest = req.payload
             if r.read_gen > self.promised:
                 self.promised = r.read_gen
+                if self._file is not None:
+                    await self._persist()  # promise must survive a reboot
             req.reply(ReadRegReply(self.value, self.write_gen, self.promised))
 
     async def _serve_write(self) -> None:
@@ -93,6 +137,8 @@ class Coordinator:
                 self.promised = r.write_gen
                 self.write_gen = r.write_gen
                 self.value = r.value
+                if self._file is not None:
+                    await self._persist()  # durable before the ack
                 req.reply(WriteRegReply(True, self.promised))
             else:
                 req.reply(WriteRegReply(False, self.promised))

@@ -32,6 +32,9 @@ class RecoverableCluster:
         n_coordinators: int = 3,
         conflict_backend: Callable[..., object] | None = None,
         knobs: CoreKnobs | None = None,
+        durable: bool = False,  # disk-backed TLogs/storage/coordinators
+        fs=None,                # SimFilesystem to reuse (cluster restart)
+        restart: bool = False,  # bootstrap from fs contents
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -39,6 +42,15 @@ class RecoverableCluster:
         self.trace = TraceCollector(clock=self.loop.now)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))
+        self.fs = None
+        if durable or fs is not None or restart:
+            from ..storage.files import SimFilesystem
+
+            if fs is not None:
+                fs.reattach(self.loop, self.rng)
+                self.fs = fs
+            else:
+                self.fs = SimFilesystem(self.loop, self.rng)
 
         def splits(n: int) -> list[bytes]:
             return [bytes([256 * i // n]) for i in range(1, n)]
@@ -47,7 +59,10 @@ class RecoverableCluster:
         resolver_splits = splits(n_resolvers)
 
         self.coordinators = [
-            Coordinator(self.net.create_process(f"coord-{i}"), self.loop)
+            Coordinator(
+                self.net.create_process(f"coord-{i}"), self.loop,
+                fs=self.fs, path=f"coord{i}.reg",
+            )
             for i in range(n_coordinators)
         ]
 
@@ -55,12 +70,26 @@ class RecoverableCluster:
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
             p = self.net.create_process(f"storage-{i}")
+            if self.fs is not None:
+                from ..storage.kvstore import DurableMemoryKeyValueStore
+
+                if restart:
+                    store = DurableMemoryKeyValueStore.recover(
+                        self.fs, f"ss{i}.kv", p
+                    )
+                else:
+                    store = DurableMemoryKeyValueStore(self.fs, f"ss{i}.kv", p)
+                start_version = store.meta.get("durable_version", 0)
+            else:
+                store = MemoryKeyValueStore()
+                start_version = 0
             # initial refs are dummies; the controller rewires on first recovery
             self.storage.append(
                 StorageServer(
                     p, self.loop, self.knobs,
                     tlog_peek_ref=None, tlog_pop_ref=None,
-                    tag=f"ss-{i}", store=MemoryKeyValueStore(),
+                    tag=f"ss-{i}", store=store,
+                    start_version=start_version,
                 )
             )
 
@@ -79,6 +108,8 @@ class RecoverableCluster:
             resolver_splits=resolver_splits,
             n_tlogs=n_tlogs,
             cstate=cstate,
+            fs=self.fs,
+            restart=restart,
         )
         self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
         from .ratekeeper import Ratekeeper
@@ -100,6 +131,25 @@ class RecoverableCluster:
 
     def run_until(self, fut, deadline: float | None = None):
         return self.loop.run_until(fut, deadline)
+
+    def power_off(self):
+        """Simulate whole-cluster power loss: every process dies at once,
+        all un-fsynced file buffers are dropped.  Returns the filesystem —
+        the only thing that survives — for a restarted cluster:
+
+            fs = cluster.power_off()
+            cluster2 = RecoverableCluster(seed=..., fs=fs, restart=True)
+        """
+        assert self.fs is not None, "power_off needs a durable cluster"
+        self.ratekeeper.stop()
+        self.controller.stop()
+        for c in self.coordinators:
+            c.stop()
+        for s in self.storage:
+            s.stop()
+        for proc in list(self.net.processes.values()):
+            proc.kill()
+        return self.fs
 
     def stop(self) -> None:
         self.ratekeeper.stop()

@@ -261,6 +261,12 @@ class StorageServer:
                 self.overlay.forget_before(
                     flush_to, self.store.set, self.store.clear_range
                 )
+                commit = getattr(self.store, "commit", None)
+                if commit is not None:
+                    # disk engine: fsync the flushed batch (+ the durable
+                    # version marker) BEFORE popping the TLog — the TLog is
+                    # the only other copy of this data
+                    await commit({"durable_version": flush_to})
                 self.durable_version = flush_to
                 if self.tlog_pop is not None:
                     self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))

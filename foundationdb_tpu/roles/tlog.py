@@ -4,11 +4,17 @@ Receives ordered mutation batches tagged per storage server (tLogCommit
 :1169), holds version-indexed per-tag queues (LogData :284), serves
 tLogPeekMessages (:932) to storage servers and trims with tLogPop (:880).
 
-This is the memory TLog; commits ack after an (optional simulated) sync
-delay.  A DiskQueue-backed variant layers underneath via the same interface
-(storage/diskqueue.py).  Version ordering is enforced with NotifiedVersion
-exactly like the resolver: a batch whose prev_version hasn't been logged
-yet waits its turn.
+Two durability modes:
+  * memory (disk_queue=None): commits ack after a simulated sync delay —
+    data dies with the process.  For tests/benches.
+  * durable (disk_queue set): every commit is framed into the DiskQueue and
+    fsynced BEFORE publication and ack (tLogCommit's fsync at :1169); the
+    full tag state is re-framed as a RESET record at generation start and
+    on compaction, so a whole-cluster power loss recovers everything acked
+    from the synced log prefix (storage/diskqueue.py recover()).
+
+Version ordering is enforced with NotifiedVersion exactly like the
+resolver: a batch whose prev_version hasn't been logged yet waits its turn.
 """
 
 from __future__ import annotations
@@ -28,6 +34,43 @@ from .types import (
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..runtime.core import EventLoop, TaskPriority
+from ..runtime.serialize import (
+    BinaryReader,
+    BinaryWriter,
+    decode_version_mutations,
+    encode_version_mutations,
+    read_mutation,
+    write_mutation,
+)
+
+# durable-log record types
+_R_RESET, _R_COMMIT, _R_POP = 0, 1, 2
+
+
+def _encode_reset(start_version: Version, known_committed: Version,
+                  tags: dict[str, list]) -> bytes:
+    w = BinaryWriter().u8(_R_RESET).i64(start_version).i64(known_committed)
+    w.u32(len(tags))
+    for tag, entries in tags.items():
+        w.str_(tag).u32(len(entries))
+        for v, muts in entries:
+            w.i64(v).u32(len(muts))
+            for m in muts:
+                write_mutation(w, m)
+    return w.data()
+
+
+def _decode_reset(r: BinaryReader):
+    start, kc = r.i64(), r.i64()
+    tags: dict[str, list] = {}
+    for _ in range(r.u32()):
+        tag = r.str_()
+        entries = []
+        for _ in range(r.u32()):
+            v = r.i64()
+            entries.append((v, [read_mutation(r) for _ in range(r.u32())]))
+        tags[tag] = entries
+    return start, kc, tags
 
 
 class TLog:
@@ -39,7 +82,8 @@ class TLog:
     def __init__(self, process: SimProcess, loop: EventLoop,
                  start_version: Version = 0, sync_delay: float = 0.0005,
                  initial_tags: dict | None = None,
-                 known_committed: Version = 0) -> None:
+                 known_committed: Version = 0,
+                 disk_queue=None) -> None:
         self.loop = loop
         self.process = process
         self.sync_delay = sync_delay
@@ -50,6 +94,14 @@ class TLog:
         self.locked = False
         # per-tag: sorted list of (version, [Mutation]); popped prefix removed
         self._tags: dict[str, list[tuple[Version, list]]] = dict(initial_tags or {})
+        self.dq = disk_queue  # storage.diskqueue.DiskQueue or None (memory)
+        self._live_bytes = 0
+        if self.dq is not None:
+            # frame the starting state; durable after initial_durable()/first
+            # commit sync.  Callers must not delete the data's previous home
+            # until then (controller awaits initial_durable before
+            # WRITING_CSTATE).
+            self.dq.push(_encode_reset(start_version, known_committed, self._tags))
         self._poppable: dict[str, Version] = {}
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
         self.peek_stream = RequestStream(process, self.WLT_PEEK)
@@ -82,12 +134,22 @@ class TLog:
         # Sync BEFORE publishing: peek/lock must never serve data that was
         # not acked durable, or storage applies versions above the eventual
         # recovery version (phantom mutations of UNKNOWN-result txns).
-        if self.sync_delay:
+        if self.dq is not None:
+            w = BinaryWriter().u8(_R_COMMIT).i64(r.known_committed)
+            self.dq.push(
+                w.data() + encode_version_mutations(r.version, r.mutations_by_tag)
+            )
+            await self.dq.sync()  # the fsync (group-commits buffered peers)
+        elif self.sync_delay:
             await self.loop.delay(self.sync_delay, TaskPriority.TLOG_COMMIT)
         if self.locked:
             return  # locked mid-sync: unacked data is lost with the epoch
+        if self.version.get() >= r.version:
+            req.reply(r.version)  # raced with a duplicate during the sync
+            return
         for tag, muts in r.mutations_by_tag.items():
             self._tags.setdefault(tag, []).append((r.version, muts))
+            self._live_bytes += sum(len(m.key) + len(m.value or b"") for m in muts)
         self.version.set(r.version)
         self.known_committed = max(self.known_committed, r.known_committed)
         req.reply(r.version)
@@ -120,7 +182,27 @@ class TLog:
             q = self._tags.get(r.tag, [])
             i = bisect.bisect_right(q, r.upto_version, key=lambda e: e[0])
             if i:
+                self._live_bytes -= sum(
+                    len(m.key) + len(m.value or b"")
+                    for _v, muts in q[:i]
+                    for m in muts
+                )
                 self._tags[r.tag] = q[i:]
+            if self.dq is not None:
+                # lazily durable: a lost POP record only means re-serving
+                # already-durable data after a crash (storage dedups by
+                # version), so no sync here
+                self.dq.push(
+                    BinaryWriter().u8(_R_POP).str_(r.tag).i64(r.upto_version).data()
+                )
+                if self.dq.bytes_pushed > 4 * max(self._live_bytes, 1) + (1 << 20):
+                    self.dq.rewrite(
+                        [
+                            _encode_reset(
+                                self.version.get(), self.known_committed, self._tags
+                            )
+                        ]
+                    )
             req.reply(None)
 
     # -- lock (recovery) ----------------------------------------------------
@@ -132,6 +214,44 @@ class TLog:
             req.reply(
                 TLogLockReply(end_version=self.version.get(), tags=dict(self._tags))
             )
+
+    async def initial_durable(self) -> None:
+        """Await durability of the construction-time RESET record.  A new
+        generation's seeds (the surviving data of the previous epoch) must
+        hit this TLog's disk before the old epoch's files/processes may be
+        discarded (controller awaits this before WRITING_CSTATE)."""
+        if self.dq is not None:
+            await self.dq.sync()
+
+    @staticmethod
+    def recover_state(dq) -> tuple[Version, Version, dict[str, list]]:
+        """Replay a durable TLog log -> (end_version, known_committed, tags).
+
+        Applies RESET/COMMIT/POP records in order over the synced prefix;
+        duplicate COMMITs for a version (proxy-retry races) apply once."""
+        end, kc = 0, 0
+        tags: dict[str, list] = {}
+        for rec in dq.recover():
+            r = BinaryReader(rec)
+            t = r.u8()
+            if t == _R_RESET:
+                end, kc, tags = _decode_reset(r)
+            elif t == _R_COMMIT:
+                rec_kc = r.i64()
+                version, by_tag = decode_version_mutations(r.rest())
+                if version <= end:
+                    continue  # duplicate push framed twice
+                for tag, muts in by_tag.items():
+                    tags.setdefault(tag, []).append((version, muts))
+                end = version
+                kc = max(kc, rec_kc)
+            elif t == _R_POP:
+                tag, upto = r.str_(), r.i64()
+                q = tags.get(tag, [])
+                i = bisect.bisect_right(q, upto, key=lambda e: e[0])
+                if i:
+                    tags[tag] = q[i:]
+        return end, kc, tags
 
     @property
     def bytes_queued(self) -> int:

@@ -44,6 +44,7 @@ class TaskPriority:
     TLOG_COMMIT = 8510
     GET_LIVE_VERSION = 8500
     DEFAULT_DELAY = 7010
+    DISK_IO = 5010  # reference TaskDiskIOComplete
     DEFAULT_ENDPOINT = 5000
     UNKNOWN_ENDPOINT = 4000
     RATEKEEPER = 3110

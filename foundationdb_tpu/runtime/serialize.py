@@ -1,0 +1,133 @@
+"""Versioned binary serialization — the flow/serialize.h analog.
+
+The reference frames every durable page and wire packet in a versioned
+binary archive (`BinaryWriter`/`BinaryReader`, protocol version constant at
+flow/serialize.h:188).  This module is the same idea in idiomatic Python:
+explicit little-endian codecs (struct), length-prefixed bytes, and a
+protocol-version header so future formats can evolve without corrupting old
+files.  Disk records (storage/diskqueue.py) and the TCP wire format
+(rpc/transport) both build on it.
+
+Deliberately NOT pickle: pickled records are neither versionable nor safe
+to read from a half-trusted disk/wire, and their byte layout is not stable
+across interpreter versions — determinism (same seed => same bytes) is a
+product property here.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable
+
+# protocol version: bump the low byte for compatible additions, high bytes
+# for breaking changes (reference currentProtocolVersion 0x0FDB00B061020001)
+PROTOCOL_VERSION = 0x0F_DB_70_01
+
+
+class BinaryWriter:
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def u8(self, v: int) -> "BinaryWriter":
+        self._parts.append(struct.pack("<B", v))
+        return self
+
+    def u32(self, v: int) -> "BinaryWriter":
+        self._parts.append(struct.pack("<I", v))
+        return self
+
+    def i64(self, v: int) -> "BinaryWriter":
+        self._parts.append(struct.pack("<q", v))
+        return self
+
+    def f64(self, v: float) -> "BinaryWriter":
+        self._parts.append(struct.pack("<d", v))
+        return self
+
+    def bytes_(self, b: bytes) -> "BinaryWriter":
+        self._parts.append(struct.pack("<I", len(b)))
+        self._parts.append(b)
+        return self
+
+    def str_(self, s: str) -> "BinaryWriter":
+        return self.bytes_(s.encode("utf-8"))
+
+    def data(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class BinaryReader:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = buf
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._buf):
+            raise ValueError("truncated record")
+        out = self._buf[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def bytes_(self) -> bytes:
+        return self._take(self.u32())
+
+    def str_(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def eof(self) -> bool:
+        return self._pos >= len(self._buf)
+
+    def rest(self) -> bytes:
+        """Remaining unread bytes (for nested decoders)."""
+        return self._buf[self._pos :]
+
+
+# ---- mutation / log-entry codecs (shared by TLog + storage engines) -------
+
+
+def write_mutation(w: BinaryWriter, m) -> None:
+    w.u8(int(m.type)).bytes_(m.key).bytes_(m.value if m.value is not None else b"")
+
+
+def read_mutation(r: BinaryReader):
+    from ..roles.types import Mutation, MutationType
+
+    t = MutationType(r.u8())
+    return Mutation(t, r.bytes_(), r.bytes_())
+
+
+def encode_version_mutations(version: int, by_tag: dict[str, list]) -> bytes:
+    """One TLog commit record: version + per-tag mutation lists."""
+    w = BinaryWriter()
+    w.i64(version).u32(len(by_tag))
+    for tag, muts in by_tag.items():
+        w.str_(tag).u32(len(muts))
+        for m in muts:
+            write_mutation(w, m)
+    return w.data()
+
+
+def decode_version_mutations(buf: bytes) -> tuple[int, dict[str, list]]:
+    r = BinaryReader(buf)
+    version = r.i64()
+    by_tag: dict[str, list] = {}
+    for _ in range(r.u32()):
+        tag = r.str_()
+        by_tag[tag] = [read_mutation(r) for _ in range(r.u32())]
+    return version, by_tag

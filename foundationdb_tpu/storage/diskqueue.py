@@ -1,0 +1,79 @@
+"""DiskQueue: checksummed framed append log over a (sim) file — the
+fdbserver/DiskQueue.actor.cpp analog (RawDiskQueue_TwoFiles :112,
+DiskQueue :644).
+
+The reference keeps a durable ring of two files with checksummed pages;
+here the same guarantees come from a single append log of framed records:
+
+    [magic u32][len u32][crc32 u32][payload bytes]
+
+`push()` buffers a record; `sync()` makes everything pushed so far durable
+(one fsync covers all buffered records — group commit, exactly how the
+TLog amortizes fsyncs).  `recover()` scans the synced prefix and stops at
+the first torn/corrupt frame — a partial trailing record (the crash case)
+is silently discarded, never served.
+
+Compaction is the owner's job (the TLog/kvstore rewrites the file with a
+fresh snapshot record when most of it is popped) via `rewrite()`.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from .files import SimFile
+
+_MAGIC = 0x51FDB701
+_HEADER = struct.Struct("<III")  # magic, len, crc32
+
+
+class DiskQueue:
+    def __init__(self, file: SimFile) -> None:
+        self.file = file
+        self.bytes_pushed = 0
+
+    # -- write path ---------------------------------------------------------
+    def push(self, payload: bytes) -> None:
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self.file.append(_HEADER.pack(_MAGIC, len(payload), crc) + payload)
+        self.bytes_pushed += len(payload)
+
+    async def sync(self) -> None:
+        await self.file.sync()
+
+    def rewrite(self, records: list[bytes]) -> None:
+        """Truncate and re-push `records` (compaction).  NOT durable until
+        the next sync — callers must sync before discarding the data the
+        old contents represented elsewhere."""
+        self.file.truncate()
+        self.bytes_pushed = 0
+        for r in records:
+            self.push(r)
+
+    # -- recovery -----------------------------------------------------------
+    def recover(self, include_unsynced: bool = False) -> list[bytes]:
+        """Scan the log; return the valid record prefix.  Stops at the first
+        torn or corrupt frame (trailing garbage from a crash mid-append).
+
+        By default only the SYNCED prefix is read — recovery happens after a
+        crash, where the page cache is gone.  include_unsynced exists for
+        same-process reads (e.g. rolling restarts without a kill)."""
+        buf = (
+            self.file.read_all()
+            if include_unsynced
+            else self.file.read_all()[: self.file.synced_size()]
+        )
+        out: list[bytes] = []
+        pos = 0
+        n = len(buf)
+        while pos + _HEADER.size <= n:
+            magic, ln, crc = _HEADER.unpack_from(buf, pos)
+            if magic != _MAGIC or pos + _HEADER.size + ln > n:
+                break  # torn/garbage frame: end of valid prefix
+            payload = bytes(buf[pos + _HEADER.size : pos + _HEADER.size + ln])
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                break  # corrupt payload
+            out.append(payload)
+            pos += _HEADER.size + ln
+        return out

@@ -1,0 +1,141 @@
+"""Simulated filesystem with kill-time data loss — the IAsyncFile /
+AsyncFileNonDurable analog (fdbrpc/IAsyncFile.h;
+fdbrpc/AsyncFileNonDurable.actor.h:173,191).
+
+The reference's durability testing rests on one property: a simulated file
+buffers writes until `sync()`, and a process kill drops (or corrupts) the
+un-synced suffix — so only data the role explicitly fsynced survives a
+crash.  `SimFilesystem` owns file state; files outlive their processes
+(they are the machine's disk), while each open handle belongs to a process
+and loses its un-synced buffer when that process dies.
+
+Latency model: writes are buffered instantly (page cache); `sync()` pays a
+seeded delay (the fsync).  Deterministic like everything else in the sim.
+"""
+
+from __future__ import annotations
+
+from ..rpc.network import SimProcess
+from ..runtime.core import DeterministicRandom, EventLoop, TaskPriority
+
+
+class _FileState:
+    __slots__ = ("synced", "unsynced")
+
+    def __init__(self) -> None:
+        self.synced = bytearray()
+        self.unsynced: list[bytes] = []  # append-only tail, lost on kill
+
+
+class SimFile:
+    """An open handle: append/sync/read of one simulated file."""
+
+    def __init__(self, fs: "SimFilesystem", path: str, state: _FileState,
+                 process: SimProcess) -> None:
+        self._fs = fs
+        self.path = path
+        self._st = state
+        self._process = process
+        self._closed = False
+
+    # -- write path ---------------------------------------------------------
+    def append(self, data: bytes) -> None:
+        """Buffered append (page cache): instant, not durable."""
+        assert not self._closed
+        self._st.unsynced.append(bytes(data))
+
+    async def sync(self) -> None:
+        """Make all buffered appends durable (fsync): pays seeded latency.
+        On return, everything appended before the call survives any kill."""
+        assert not self._closed
+        loop, rng = self._fs.loop, self._fs.rng
+        await loop.delay(
+            self._fs.min_sync_latency
+            + rng.random() * (self._fs.max_sync_latency - self._fs.min_sync_latency),
+            TaskPriority.DISK_IO,
+        )
+        if self._process is not None and not self._process.alive:
+            return  # killed mid-fsync: buffers already dropped
+        if self._st.unsynced:
+            for chunk in self._st.unsynced:
+                self._st.synced.extend(chunk)
+            self._st.unsynced.clear()
+
+    def truncate(self) -> None:
+        """Drop all contents (both synced and buffered)."""
+        assert not self._closed
+        self._st.synced = bytearray()
+        self._st.unsynced.clear()
+
+    # -- read path ----------------------------------------------------------
+    def read_all(self) -> bytes:
+        """Synced + buffered contents, as a reader on this machine sees it."""
+        out = bytearray(self._st.synced)
+        for chunk in self._st.unsynced:
+            out.extend(chunk)
+        return bytes(out)
+
+    def synced_size(self) -> int:
+        return len(self._st.synced)
+
+    def size(self) -> int:
+        return len(self._st.synced) + sum(len(c) for c in self._st.unsynced)
+
+    def _drop_unsynced(self) -> None:
+        self._st.unsynced.clear()
+
+    def close(self) -> None:
+        self._closed = True
+        self._fs._handles.get(self._process, set()).discard(self)
+
+
+class SimFilesystem:
+    """All simulated disks; survives cluster restarts (it IS the disks)."""
+
+    # TaskPriority for disk completions mirrors the reference's DiskIOComplete
+
+    def __init__(self, loop: EventLoop, rng: DeterministicRandom,
+                 min_sync_latency: float = 0.0005,
+                 max_sync_latency: float = 0.005) -> None:
+        self.loop = loop
+        self.rng = rng.split()
+        self.min_sync_latency = min_sync_latency
+        self.max_sync_latency = max_sync_latency
+        self._files: dict[str, _FileState] = {}
+        self._handles: dict[SimProcess, set[SimFile]] = {}
+
+    def reattach(self, loop: EventLoop, rng: DeterministicRandom) -> None:
+        """Point at a new EventLoop/RNG (whole-cluster restart builds a new
+        loop but the disks persist)."""
+        self.loop = loop
+        self.rng = rng.split()
+        self._handles.clear()
+
+    def open(self, path: str, process: SimProcess) -> SimFile:
+        state = self._files.setdefault(path, _FileState())
+        f = SimFile(self, path, state, process)
+        if process is not None:
+            handles = self._handles.setdefault(process, set())
+            if not handles:
+                # first open by this process: arm the kill hook
+                from ..runtime.core import Promise
+
+                p = Promise()
+
+                def on_death(_f) -> None:
+                    for h in self._handles.pop(process, set()):
+                        h._drop_unsynced()
+
+                p.future.add_done_callback(on_death)
+                process.on_death.append(p)
+            handles.add(f)
+        return f
+
+    def exists(self, path: str) -> bool:
+        return path in self._files
+
+    def delete(self, path: str) -> None:
+        self._files.pop(path, None)
+
+    def list(self, prefix: str = "") -> list[str]:
+        return sorted(p for p in self._files if p.startswith(prefix))

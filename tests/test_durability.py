@@ -1,0 +1,229 @@
+"""Durability plane: DiskQueue framing/recovery, durable KV engine, durable
+TLogs, and whole-cluster power-loss restart (the reference's
+tests/restarting/ + AsyncFileNonDurable data-loss model —
+fdbserver/DiskQueue.actor.cpp, KeyValueStoreMemory.actor.cpp,
+fdbrpc/AsyncFileNonDurable.actor.h:173).
+"""
+
+import pytest
+
+from foundationdb_tpu.roles.types import Mutation, MutationType
+from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+from foundationdb_tpu.storage.diskqueue import DiskQueue
+from foundationdb_tpu.storage.files import SimFilesystem
+from foundationdb_tpu.storage.kvstore import DurableMemoryKeyValueStore
+
+
+def mk_env(seed=1):
+    loop = EventLoop()
+    rng = DeterministicRandom(seed)
+    fs = SimFilesystem(loop, rng)
+    return loop, fs
+
+
+def drain(loop, coro):
+    return loop.run_until(loop.spawn(coro), deadline=60.0)
+
+
+class TestSimFile:
+    def test_unsynced_lost_on_kill(self):
+        from foundationdb_tpu.rpc.network import SimNetwork
+
+        loop = EventLoop()
+        rng = DeterministicRandom(3)
+        net = SimNetwork(loop, rng)
+        fs = SimFilesystem(loop, rng)
+        proc = net.create_process("p")
+        f = fs.open("x", proc)
+        f.append(b"synced")
+
+        async def go():
+            await f.sync()
+            f.append(b"lost")
+
+        drain(loop, go())
+        proc.kill()
+        f2 = fs.open("x", None)
+        assert f2.read_all() == b"synced"
+
+    def test_synced_survives_kill(self):
+        from foundationdb_tpu.rpc.network import SimNetwork
+
+        loop = EventLoop()
+        rng = DeterministicRandom(3)
+        net = SimNetwork(loop, rng)
+        fs = SimFilesystem(loop, rng)
+        proc = net.create_process("p")
+        f = fs.open("x", proc)
+        f.append(b"a")
+        f.append(b"b")
+        drain(loop, f.sync())
+        proc.kill()
+        assert fs.open("x", None).read_all() == b"ab"
+
+
+class TestDiskQueue:
+    def test_push_sync_recover(self):
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("q", None))
+        dq.push(b"one")
+        dq.push(b"two")
+        drain(loop, dq.sync())
+        dq.push(b"unsynced")
+        dq2 = DiskQueue(fs.open("q", None))
+        assert dq2.recover() == [b"one", b"two"]
+        assert dq2.recover(include_unsynced=True) == [b"one", b"two", b"unsynced"]
+
+    def test_torn_tail_discarded(self):
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("q", None))
+        dq.push(b"good")
+        drain(loop, dq.sync())
+        # simulate a torn write: garbage appended and synced (e.g. a crash
+        # mid-page where the frame header landed but the payload is junk)
+        f = fs.open("q", None)
+        f.append(b"\x01\xb7\xfdQ\x99\x00\x00\x00")  # valid magic, absurd len
+        drain(loop, f.sync())
+        assert DiskQueue(fs.open("q", None)).recover() == [b"good"]
+
+    def test_corrupt_crc_discarded(self):
+        import struct
+
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("q", None))
+        dq.push(b"good")
+        drain(loop, dq.sync())
+        f = fs.open("q", None)
+        bad = struct.pack("<III", 0x51FDB701, 3, 0xDEAD) + b"xyz"
+        f.append(bad)
+        drain(loop, f.sync())
+        assert DiskQueue(fs.open("q", None)).recover() == [b"good"]
+
+
+class TestDurableKV:
+    def test_commit_then_recover(self):
+        loop, fs = mk_env()
+        kv = DurableMemoryKeyValueStore(fs, "kv", None)
+        kv.set(b"a", b"1")
+        kv.set(b"b", b"2")
+        drain(loop, kv.commit({"durable_version": 7}))
+        kv.set(b"c", b"3")  # never committed
+        kv2 = DurableMemoryKeyValueStore.recover(fs, "kv", None)
+        assert kv2.get(b"a") == b"1" and kv2.get(b"b") == b"2"
+        assert kv2.get(b"c") is None  # uncommitted tail dropped
+        assert kv2.meta["durable_version"] == 7
+
+    def test_clear_range_and_snapshot_cycle(self):
+        loop, fs = mk_env()
+        kv = DurableMemoryKeyValueStore(fs, "kv", None)
+        for i in range(50):
+            kv.set(b"k%03d" % i, b"v%d" % i)
+        kv.clear_range(b"k010", b"k020")
+        drain(loop, kv.commit())
+        kv._write_snapshot()
+        drain(loop, kv.commit())
+        kv2 = DurableMemoryKeyValueStore.recover(fs, "kv", None)
+        assert kv2.get(b"k005") == b"v5"
+        assert kv2.get(b"k015") is None
+        assert kv2.key_count() == 40
+
+
+class TestClusterRestart:
+    def test_power_loss_preserves_committed_data(self):
+        """Kill the ENTIRE cluster; relaunch from files; committed data is
+        all there and the cluster accepts new commits."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        c = RecoverableCluster(seed=41, n_storage_shards=2, durable=True)
+        db = c.database()
+
+        async def write_phase():
+            for i in range(8):
+                tr = db.create_transaction()
+                tr.set(b"key/%02d" % i, b"val%d" % i)
+                await tr.commit()
+            # let storage flush past the MVCC window? No: power loss happens
+            # NOW, mid-window — recovery must replay from TLog files alone.
+
+        c.run_until(c.loop.spawn(write_phase()), 60)
+        fs = c.power_off()
+
+        c2 = RecoverableCluster(seed=42, n_storage_shards=2, fs=fs, restart=True)
+        db2 = c2.database()
+
+        async def read_phase():
+            tr = db2.create_transaction()
+            vals = [await tr.get(b"key/%02d" % i) for i in range(8)]
+            tr2 = db2.create_transaction()
+            tr2.set(b"post-restart", b"yes")
+            await tr2.commit()
+            tr3 = db2.create_transaction()
+            return vals, await tr3.get(b"post-restart")
+
+        vals, post = c2.run_until(c2.loop.spawn(read_phase()), 120)
+        assert vals == [b"val%d" % i for i in range(8)]
+        assert post == b"yes"
+        c2.stop()
+
+    def test_power_loss_mid_cycle_invariant(self):
+        """Cycle workload, power loss mid-run, restart: the cycle invariant
+        (sum preserved) holds over the committed prefix."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+        from foundationdb_tpu.workloads.base import run_workloads
+
+        c = RecoverableCluster(seed=43, n_storage_shards=2, durable=True)
+        cyc = CycleWorkload(nodes=8, clients=2, txns_per_client=8)
+        run_workloads(c, [cyc], deadline=300.0)
+        fs = c.power_off()
+
+        c2 = RecoverableCluster(seed=44, n_storage_shards=2, fs=fs, restart=True)
+        db2 = c2.database()
+
+        async def check():
+            tr = db2.create_transaction()
+            rows = await tr.get_range(b"cycle/", b"cycle0", limit=1000)
+            return rows
+
+        rows = c2.run_until(c2.loop.spawn(check()), 120)
+        # cycle invariant: the nodes form one permutation cycle
+        kv = dict(rows)
+        assert len(kv) == 8, f"expected 8 cycle nodes, got {len(kv)}"
+        nxt = {int(k.split(b"/")[1]): int(v) for k, v in kv.items()}
+        seen, cur = set(), 0
+        for _ in range(8):
+            assert cur not in seen
+            seen.add(cur)
+            cur = nxt[cur]
+        assert cur == 0, "not a single cycle"
+        c2.stop()
+
+    def test_restart_determinism(self):
+        """Same seeds, same power-loss point => identical restarted state."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        def once():
+            c = RecoverableCluster(seed=45, durable=True)
+            db = c.database()
+
+            async def w():
+                for i in range(5):
+                    tr = db.create_transaction()
+                    tr.set(b"k%d" % i, b"v%d" % i)
+                    await tr.commit()
+
+            c.run_until(c.loop.spawn(w()), 60)
+            fs = c.power_off()
+            c2 = RecoverableCluster(seed=46, fs=fs, restart=True)
+            db2 = c2.database()
+
+            async def r():
+                tr = db2.create_transaction()
+                return [await tr.get(b"k%d" % i) for i in range(5)]
+
+            out = c2.run_until(c2.loop.spawn(r()), 60)
+            epoch = c2.controller.epoch
+            c2.stop()
+            return out, epoch
+
+        assert once() == once()
