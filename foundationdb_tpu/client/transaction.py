@@ -34,6 +34,29 @@ from ..rpc.stream import RequestStreamRef
 from ..runtime.core import DeterministicRandom, EventLoop, TimedOut
 from ..keys import key_after
 
+# errors a client retry loop may transparently retry (the onError set,
+# NativeAPI.actor.cpp:2543 — not_committed / transaction_too_old /
+# future_version / commit_unknown_result / proxy-unreachable timeouts)
+RETRYABLE_ERRORS = (
+    NotCommitted,
+    TransactionTooOld,
+    FutureVersion,
+    CommitUnknownResult,
+    TimedOut,
+)
+
+
+def _intersect_ranges(
+    a: list[tuple[bytes, bytes]], b: list[tuple[bytes, bytes]]
+) -> tuple[bytes, bytes] | None:
+    """First non-empty intersection of any range in `a` with any in `b`."""
+    for ab, ae in a:
+        for bb, be in b:
+            lo, hi = max(ab, bb), min(ae, be)
+            if lo < hi:
+                return lo, hi
+    return None
+
 
 class ClusterView:
     """The client's window onto the current cluster generation — the
@@ -94,25 +117,23 @@ class Database:
 
     async def run(self, fn, max_retries: int = 50):
         """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
-        errors back off and start over with a fresh read version.
-        CommitUnknownResult is retried too — safe for idempotent or
-        self-verifying transactions, the reference's contract."""
-        backoff = 0.01
+        errors `tr.on_error` backs off — and for CommitUnknownResult first
+        fences the in-flight original with the dummy-transaction dance
+        (NativeAPI.actor.cpp:2482-2502) — then the loop starts over with a
+        fresh read version.
+
+        The fence only prevents the zombie-commit race (the original landing
+        AFTER the retry's reads); a CommitUnknownResult retry can still
+        re-apply fn if the original committed — safe only for idempotent or
+        self-verifying transactions, the same contract as the reference."""
+        tr = self.create_transaction()
         for _attempt in range(max_retries):
-            tr = self.create_transaction()
             try:
                 result = await fn(tr)
                 await tr.commit()
                 return result
-            except (
-                NotCommitted,
-                TransactionTooOld,
-                FutureVersion,
-                CommitUnknownResult,
-                TimedOut,
-            ):
-                await self.loop.delay(backoff * (0.5 + self._rng.random()))
-                backoff = min(backoff * 2, 1.0)
+            except RETRYABLE_ERRORS as e:
+                await tr.on_error(e)
         raise NotCommitted(f"transaction failed after {max_retries} retries")
 
 
@@ -124,6 +145,57 @@ class Transaction:
         self._read_ranges: list[tuple[bytes, bytes]] = []
         self._write_ranges: list[tuple[bytes, bytes]] = []
         self.committed_version: Version | None = None
+        self._backoff = 0.01  # carried across on_error resets
+
+    def reset(self) -> None:
+        """Clear all transaction state for a retry (fresh read version,
+        empty mutation/conflict sets); the retry backoff is preserved."""
+        self._read_version = None
+        self._mutations = []
+        self._read_ranges = []
+        self._write_ranges = []
+        self.committed_version = None
+
+    async def on_error(self, e: BaseException) -> None:
+        """The reference's tr.onError contract (NativeAPI.actor.cpp:2543):
+        for a retryable error, back off and reset this transaction so the
+        caller can re-run its body.  Non-retryable errors re-raise.
+
+        For CommitUnknownResult the in-flight original commit is first
+        FENCED (:2482-2502): commit a dummy transaction whose write set
+        intersects this transaction's read conflict ranges.  Once the dummy
+        commits, the original — whose read snapshot predates it — can never
+        commit afterwards, so the retry cannot race a zombie commit into a
+        double-apply.  The intersection always exists because commit()
+        makes every transaction self-conflicting when its read and write
+        sets are disjoint."""
+        if not isinstance(e, RETRYABLE_ERRORS):
+            raise e
+        if isinstance(e, CommitUnknownResult) and self._write_ranges:
+            fence = _intersect_ranges(self._write_ranges, self._read_ranges)
+            if fence is not None:
+                await self._commit_fence(fence[0])
+        await self.db.loop.delay(self._backoff * (0.5 + self.db._rng.random()))
+        self._backoff = min(self._backoff * 2, 1.0)
+        self.reset()
+
+    async def _commit_fence(self, key: bytes) -> None:
+        """Commit a dummy transaction conflicting with the original
+        (commitDummyTransaction, NativeAPI.actor.cpp:2380): read+write
+        conflict ranges on one key, no mutations.  Retries until it lands;
+        a dummy's own unknown result is safe to retry (it is idempotent)."""
+        for _ in range(50):
+            dummy = self.db.create_transaction()
+            dummy.add_read_conflict_range(key, key_after(key))
+            dummy.add_write_conflict_range(key, key_after(key))
+            try:
+                await dummy.commit()
+                return
+            except RETRYABLE_ERRORS:
+                await self.db.loop.delay(
+                    self._backoff * (0.5 + self.db._rng.random())
+                )
+        raise CommitUnknownResult("fence transaction could not commit")
 
     # -- read version -------------------------------------------------------
     async def get_read_version(self) -> Version:
@@ -191,6 +263,15 @@ class Transaction:
             self.committed_version = self._read_version or 0
             return self.committed_version  # read-only: nothing to commit
         v = await self.get_read_version()
+        if _intersect_ranges(self._write_ranges, self._read_ranges) is None:
+            # make the transaction self-conflicting (the reference's
+            # makeSelfConflicting under !causalWriteRisky): gives on_error's
+            # unknown-result fence a range that aborts the in-flight
+            # original for certain.  A unique key adds no spurious
+            # conflicts with other transactions.
+            sc = b"\xff/SC/" + self.db._rng.random_unique_id().encode()
+            self._read_ranges.append((sc, key_after(sc)))
+            self._write_ranges.append((sc, key_after(sc)))
         req = CommitTransactionRequest(
             read_snapshot=v,
             read_conflict_ranges=list(self._read_ranges),

@@ -154,7 +154,8 @@ class ClusterController:
                 # only as files; replay their synced logs in place of lock
                 # replies (SimulatedCluster restartSimulatedSystem analog)
                 recovery_version, tag_data = self._recover_tlogs_from_disk(
-                    prev_state["epoch"]
+                    prev_state["epoch"],
+                    prev_state.get("n_tlogs", self.n_tlogs),
                 )
             else:
                 recovery_version, tag_data = await self._lock_old_tlogs(old)
@@ -188,11 +189,13 @@ class ClusterController:
                     raise RuntimeError("lost cstate race: a newer master exists")
             if self.fs is not None:
                 # previous epochs' TLog files are superseded by this epoch's
-                # durable RESETs + the cstate record naming this epoch
-                for i in range(self.n_tlogs):
-                    for path in self.fs.list(f"tlog{i}-e"):
-                        if path != self._tlog_path(i, self.epoch):
-                            self.fs.delete(path)
+                # durable RESETs + the cstate record naming this epoch;
+                # enumerate ALL tlog files (old epochs may have had more
+                # slots than the current config)
+                current = {self._tlog_path(i, self.epoch) for i in range(self.n_tlogs)}
+                for path in self.fs.list("tlog"):
+                    if path not in current:
+                        self.fs.delete(path)
 
             self.generation = gen
             self._set_state(RecoveryState.ACCEPTING_COMMITS)
@@ -244,15 +247,20 @@ class ClusterController:
     def _tlog_path(self, slot: int, epoch: int) -> str:
         return f"tlog{slot}-e{epoch}.dq"
 
-    def _recover_tlogs_from_disk(self, prev_epoch: int):
+    def _recover_tlogs_from_disk(self, prev_epoch: int, prev_n_tlogs: int):
         """Whole-cluster restart: rebuild (recovery_version, seeds) from the
         previous epoch's synced TLog files.  Unsynced suffixes died with the
         power loss; every acked commit was synced on EVERY replica, so the
-        min over recovered ends keeps all acked data."""
+        min over recovered ends keeps all acked data.
+
+        Enumerates the PREVIOUS epoch's slot count (recorded in the cstate
+        write), not the new config's — restarting with fewer TLog slots must
+        still replay every old slot's file or tags whose replica pair lived
+        in the dropped slots would be silently lost."""
         from ..storage.diskqueue import DiskQueue
 
         replies = []
-        for i in range(self.n_tlogs):
+        for i in range(prev_n_tlogs):
             path = self._tlog_path(i, prev_epoch)
             if not self.fs.exists(path):
                 replies.append(None)
@@ -261,22 +269,32 @@ class ClusterController:
             end, _kc, tags = TLog.recover_state(dq)
             replies.append(TLogLockReply(end_version=end, tags=tags))
         alive = [r for r in replies if r is not None]
-        if len(alive) < self.n_tlogs:
-            # with 2x tag replication, one missing slot is survivable (its
-            # tags exist on the neighbor); zero survivors is not
-            if not alive:
-                raise RuntimeError("no TLog files recovered: data loss")
+        if not alive:
+            raise RuntimeError("no TLog files recovered: data loss")
+        if len(alive) < prev_n_tlogs:
+            # with 2x tag replication a missing slot is survivable only if
+            # every tag's OLD replica pair still has one surviving file —
+            # two missing slots that formed a pair mean silent loss of that
+            # pair's tags, which must be an error, not a quiet proceed
+            for s in self.storage:
+                pair = self._tag_tlogs(s.tag, prev_n_tlogs)
+                if all(replies[i] is None for i in pair):
+                    raise RuntimeError(
+                        f"tag {s.tag}: all replica slots {pair} lost — data loss"
+                    )
         recovery_version = min(r.end_version for r in alive)
         return recovery_version, self._merge_tlog_replies(alive, recovery_version)
 
-    def _tag_tlogs(self, tag: str) -> list[int]:
+    def _tag_tlogs(self, tag: str, n_tlogs: int | None = None) -> list[int]:
         """TLog replica set for a tag: primary + next (2x log replication —
         the reference replicates each mutation to a TLog team under policy;
-        one TLog loss keeps every tag recoverable)."""
-        primary = int(tag.split("-")[-1]) % self.n_tlogs
-        if self.n_tlogs == 1:
+        one TLog loss keeps every tag recoverable).  Pass `n_tlogs` to
+        compute a PREVIOUS epoch's replica map during disk recovery."""
+        n = self.n_tlogs if n_tlogs is None else n_tlogs
+        primary = int(tag.split("-")[-1]) % n
+        if n == 1:
             return [0]
-        return [primary, (primary + 1) % self.n_tlogs]
+        return [primary, (primary + 1) % n]
 
     def _cc_proc(self) -> SimProcess:
         if not hasattr(self, "_cc_process"):

@@ -77,6 +77,12 @@ class Sequencer:
         # a single-entry cache would hand an old retry a newer batch's
         # versions (two batches sharing one commit version = lost writes).
         self._replies: dict[str, dict[int, GetCommitVersionReply]] = {}
+        # highest request_num EVICTED from each proxy's cache after version
+        # assignment: only those may be silently ignored (we can no longer
+        # prove the retry wasn't already assigned a version).  A merely
+        # lower-numbered fresh request is a legitimate out-of-order arrival
+        # (pipelined batches retry independently) and gets a fresh version.
+        self._evicted_upto: dict[str, int] = {}
         self._cache_cap = 4096
         self._task = loop.spawn(self._serve(), TaskPriority.GET_LIVE_VERSION, "sequencer")
 
@@ -98,17 +104,25 @@ class Sequencer:
             if cached is not None:
                 req.reply(cached)  # duplicate (proxy retry): same versions
                 continue
-            if cache and r.request_num < next(reversed(cache)):
-                # stale retry of an evicted request: assigning a fresh
-                # version would duplicate the original; stay silent — the
-                # proxy gives up and escalates to recovery
+            if r.request_num <= self._evicted_upto.get(r.requesting_proxy, -1):
+                # retry of an EVICTED request: it may already hold a version;
+                # assigning a fresh one would duplicate the batch.  Stay
+                # silent — the proxy gives up and escalates to recovery.
                 continue
             v = self._next_version()
             reply = GetCommitVersionReply(prev_version=self._last_assigned, version=v)
             self._last_assigned = v
             cache[r.request_num] = reply
             while len(cache) > self._cache_cap:
-                del cache[next(iter(cache))]
+                # evict the NUMERICALLY lowest request_num, not insertion
+                # order: the watermark below must stay an exact boundary —
+                # insertion-order eviction of an out-of-order high num
+                # would drag the watermark up and silently drop fresh
+                # lower-numbered requests that were never assigned
+                evicted = min(cache)
+                del cache[evicted]
+                prev = self._evicted_upto.get(r.requesting_proxy, -1)
+                self._evicted_upto[r.requesting_proxy] = max(prev, evicted)
             req.reply(reply)
 
     def stop(self) -> None:

@@ -43,9 +43,11 @@ class DiskQueue:
         await self.file.sync()
 
     def rewrite(self, records: list[bytes]) -> None:
-        """Truncate and re-push `records` (compaction).  NOT durable until
-        the next sync — callers must sync before discarding the data the
-        old contents represented elsewhere."""
+        """Truncate and re-push `records` (compaction).  The truncate is
+        JOURNALED (files.SimFile.truncate): the old synced contents stay
+        recoverable until the next successful sync() makes the replacement
+        durable, so a crash in the window recovers the pre-compaction log —
+        never an empty file."""
         self.file.truncate()
         self.bytes_pushed = 0
         for r in records:
@@ -60,9 +62,7 @@ class DiskQueue:
         crash, where the page cache is gone.  include_unsynced exists for
         same-process reads (e.g. rolling restarts without a kill)."""
         buf = (
-            self.file.read_all()
-            if include_unsynced
-            else self.file.read_all()[: self.file.synced_size()]
+            self.file.read_all() if include_unsynced else self.file.read_durable()
         )
         out: list[bytes] = []
         pos = 0

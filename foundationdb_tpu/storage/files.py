@@ -20,11 +20,15 @@ from ..runtime.core import DeterministicRandom, EventLoop, TaskPriority
 
 
 class _FileState:
-    __slots__ = ("synced", "unsynced")
+    __slots__ = ("synced", "unsynced", "pending_truncate")
 
     def __init__(self) -> None:
         self.synced = bytearray()
         self.unsynced: list[bytes] = []  # append-only tail, lost on kill
+        # truncate() is journaled: the synced prefix survives until the next
+        # successful sync() applies it, so compaction can never destroy
+        # durable data before its replacement is durable.
+        self.pending_truncate = False
 
 
 class SimFile:
@@ -56,33 +60,46 @@ class SimFile:
         )
         if self._process is not None and not self._process.alive:
             return  # killed mid-fsync: buffers already dropped
+        if self._st.pending_truncate:
+            self._st.synced = bytearray()
+            self._st.pending_truncate = False
         if self._st.unsynced:
             for chunk in self._st.unsynced:
                 self._st.synced.extend(chunk)
             self._st.unsynced.clear()
 
     def truncate(self) -> None:
-        """Drop all contents (both synced and buffered)."""
+        """Journaled truncate: buffered contents are dropped now, but the
+        SYNCED prefix stays durable until the next successful sync() — a
+        crash in between recovers the old contents, never an empty file
+        (the rewrite-then-crash hole of naive compaction)."""
         assert not self._closed
-        self._st.synced = bytearray()
         self._st.unsynced.clear()
+        self._st.pending_truncate = True
 
     # -- read path ----------------------------------------------------------
     def read_all(self) -> bytes:
-        """Synced + buffered contents, as a reader on this machine sees it."""
-        out = bytearray(self._st.synced)
+        """Contents as a same-process reader sees them (pending ops applied)."""
+        out = bytearray() if self._st.pending_truncate else bytearray(self._st.synced)
         for chunk in self._st.unsynced:
             out.extend(chunk)
         return bytes(out)
+
+    def read_durable(self) -> bytes:
+        """The crash-surviving contents: the synced prefix, ignoring any
+        not-yet-applied truncate and unsynced appends."""
+        return bytes(self._st.synced)
 
     def synced_size(self) -> int:
         return len(self._st.synced)
 
     def size(self) -> int:
-        return len(self._st.synced) + sum(len(c) for c in self._st.unsynced)
+        base = 0 if self._st.pending_truncate else len(self._st.synced)
+        return base + sum(len(c) for c in self._st.unsynced)
 
     def _drop_unsynced(self) -> None:
         self._st.unsynced.clear()
+        self._st.pending_truncate = False
 
     def close(self) -> None:
         self._closed = True

@@ -11,7 +11,7 @@ it."""
 from __future__ import annotations
 
 from .base import Workload
-from ..roles.types import NotCommitted, TransactionTooOld
+from ..client.transaction import RETRYABLE_ERRORS
 from ..runtime.combinators import wait_all
 
 
@@ -40,10 +40,13 @@ class CycleWorkload(Workload):
         db = cluster.database()
 
         async def client(crng):
+            # a rotation retried after CommitUnknownResult is safe: on_error
+            # fences the in-flight original, and the retry re-reads state —
+            # either outcome of the original yields a valid rotation
             for _ in range(self.txns_per_client):
+                tr = db.create_transaction()
                 while True:
                     try:
-                        tr = db.create_transaction()
                         a = crng.random_int(0, self.nodes)
                         b = int(await tr.get(_key(a)))
                         c = int(await tr.get(_key(b)))
@@ -54,9 +57,9 @@ class CycleWorkload(Workload):
                         await tr.commit()
                         self.committed += 1
                         break
-                    except (NotCommitted, TransactionTooOld):
+                    except RETRYABLE_ERRORS as e:
                         self.retries += 1
-                        await cluster.loop.delay(0.001 + crng.random() * 0.01)
+                        await tr.on_error(e)
 
         await wait_all(
             [cluster.loop.spawn(client(rng.split())) for _ in range(self.clients)]

@@ -1,0 +1,211 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md):
+
+1. (high) DiskQueue.rewrite() destroyed the synced prefix before the
+   replacement snapshot was durable — a power loss between compaction and
+   the next fsync recovered an EMPTY log (lost acked commits).  truncate()
+   is now journaled: the old synced contents survive until the next
+   successful sync().
+2. (medium) Whole-cluster restart enumerated TLog slots with the NEW
+   config's n_tlogs, silently skipping higher-slot files when restarting
+   with fewer slots — losing tags whose replica pair lived in the dropped
+   slots.  Recovery now uses the slot count recorded in the cstate write.
+3. (low) A fresh-but-lower request_num was silently dropped as a "stale
+   retry", wedging an out-of-order in-flight batch until the proxy's
+   give-up deadline forced an unnecessary recovery.  The sequencer now only
+   goes silent for request_nums actually evicted after assignment.
+"""
+
+from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+from foundationdb_tpu.storage.diskqueue import DiskQueue
+from foundationdb_tpu.storage.files import SimFilesystem
+
+
+def mk_env(seed=1):
+    loop = EventLoop()
+    rng = DeterministicRandom(seed)
+    fs = SimFilesystem(loop, rng)
+    return loop, fs
+
+
+def drain(loop, coro):
+    return loop.run_until(loop.spawn(coro), deadline=60.0)
+
+
+class TestRewriteCrashWindow:
+    def test_unsynced_rewrite_recovers_old_contents(self):
+        """Crash between rewrite() and the next sync(): recovery must see
+        the PRE-compaction log, never an empty file."""
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("q", None))
+        dq.push(b"one")
+        dq.push(b"two")
+        drain(loop, dq.sync())
+        dq.rewrite([b"snapshot"])
+        # no sync: the power loss happens here
+        assert DiskQueue(fs.open("q", None)).recover() == [b"one", b"two"]
+        # same-process readers see the compacted view
+        assert dq.recover(include_unsynced=True) == [b"snapshot"]
+        # once synced, the replacement is the durable contents
+        drain(loop, dq.sync())
+        assert DiskQueue(fs.open("q", None)).recover() == [b"snapshot"]
+
+    def test_rewrite_then_pushes_then_sync(self):
+        """Records pushed after an unsynced rewrite become durable together
+        with the truncate at the next sync (no torn half-state)."""
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("q", None))
+        dq.push(b"old")
+        drain(loop, dq.sync())
+        dq.rewrite([b"snap"])
+        dq.push(b"later")
+        assert DiskQueue(fs.open("q", None)).recover() == [b"old"]
+        drain(loop, dq.sync())
+        assert DiskQueue(fs.open("q", None)).recover() == [b"snap", b"later"]
+
+    def test_kvstore_snapshot_crash_window(self):
+        """A crash during the fsync latency of the commit that carries a
+        snapshot rewrite must recover the old committed state, not empty."""
+        from foundationdb_tpu.storage.kvstore import DurableMemoryKeyValueStore
+
+        loop, fs = mk_env()
+        kv = DurableMemoryKeyValueStore(fs, "kv", None)
+        kv.set(b"a", b"1")
+        kv.set(b"b", b"2")
+        drain(loop, kv.commit({"durable_version": 5}))
+        kv._write_snapshot()  # compaction staged, NOT yet durable — crash now
+        kv2 = DurableMemoryKeyValueStore.recover(fs, "kv", None)
+        assert kv2.get(b"a") == b"1" and kv2.get(b"b") == b"2"
+        assert kv2.meta["durable_version"] == 5
+
+    def test_recover_resnapshot_crash_window(self):
+        """recover() itself re-logs a fresh snapshot without syncing; a
+        second crash before any commit must STILL recover the data."""
+        from foundationdb_tpu.storage.kvstore import DurableMemoryKeyValueStore
+
+        loop, fs = mk_env()
+        kv = DurableMemoryKeyValueStore(fs, "kv", None)
+        kv.set(b"a", b"1")
+        drain(loop, kv.commit())
+        kv2 = DurableMemoryKeyValueStore.recover(fs, "kv", None)
+        # crash immediately after recovery (its snapshot is unsynced)
+        kv3 = DurableMemoryKeyValueStore.recover(fs, "kv", None)
+        assert kv3.get(b"a") == b"1"
+
+
+class TestRestartFewerTLogSlots:
+    def test_restart_with_fewer_slots_keeps_all_tags(self):
+        """Previous epoch ran 4 TLog slots (tag ss-2's replica pair lives
+        entirely in slots 2,3); restarting with 2 slots must still replay
+        those files or shard 2's data silently vanishes."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        c = RecoverableCluster(
+            seed=61, n_storage_shards=3, n_tlogs=4, durable=True
+        )
+        db = c.database()
+        keys = [b"\x10low", b"\x70mid", b"\xcchigh"]  # one key per shard
+
+        async def write_phase():
+            for i, k in enumerate(keys):
+                tr = db.create_transaction()
+                tr.set(k, b"v%d" % i)
+                await tr.commit()
+
+        c.run_until(c.loop.spawn(write_phase()), 60)
+        fs = c.power_off()
+
+        c2 = RecoverableCluster(
+            seed=62, n_storage_shards=3, n_tlogs=2, fs=fs, restart=True
+        )
+        db2 = c2.database()
+
+        async def read_phase():
+            tr = db2.create_transaction()
+            return [await tr.get(k) for k in keys]
+
+        vals = c2.run_until(c2.loop.spawn(read_phase()), 120)
+        assert vals == [b"v0", b"v1", b"v2"]
+        c2.stop()
+
+
+class TestSequencerOutOfOrder:
+    def _mk(self):
+        from foundationdb_tpu.roles.sequencer import Sequencer
+        from foundationdb_tpu.rpc.network import SimNetwork
+        from foundationdb_tpu.rpc.stream import RequestStreamRef
+        from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+        loop = EventLoop()
+        net = SimNetwork(loop, DeterministicRandom(9))
+        seq = Sequencer(net.create_process("seq"), loop, CoreKnobs())
+        ref = RequestStreamRef(
+            net, net.create_process("proxy"), seq.stream.endpoint
+        )
+        return loop, seq, ref
+
+    def test_fresh_lower_request_num_gets_version(self):
+        """request 2 arrives before request 1 (independent pipelined batch
+        retries reordered by the network): BOTH must be assigned versions."""
+        from foundationdb_tpu.roles.types import GetCommitVersionRequest
+
+        loop, seq, ref = self._mk()
+
+        async def main():
+            b = await ref.get_reply(GetCommitVersionRequest("p1", 2))
+            a = await ref.get_reply(GetCommitVersionRequest("p1", 1), timeout=2.0)
+            return a, b
+
+        a, b = loop.run_until(loop.spawn(main()), deadline=10.0)
+        assert b.version > 0
+        assert a.version > b.version  # fresh assignment, chained after b
+        assert a.prev_version == b.version
+
+    def test_evicted_request_num_stays_silent(self):
+        """A retry of a request_num that was evicted after assignment may
+        already hold a version: the sequencer must NOT assign a fresh one."""
+        from foundationdb_tpu.roles.types import GetCommitVersionRequest
+        from foundationdb_tpu.runtime.core import TimedOut
+
+        loop, seq, ref = self._mk()
+        seq._cache_cap = 2
+
+        async def main():
+            for n in (1, 2, 3, 4):  # evicts 1 and 2 from the cache
+                await ref.get_reply(GetCommitVersionRequest("p1", n))
+            try:
+                await ref.get_reply(GetCommitVersionRequest("p1", 1), timeout=0.5)
+                return "replied"
+            except TimedOut:
+                return "silent"
+
+        assert loop.run_until(loop.spawn(main()), deadline=10.0) == "silent"
+
+
+class TestLostReplicaPair:
+    def test_both_slots_of_a_pair_missing_is_an_error(self):
+        """If BOTH files of some tag's old replica pair are gone, restart
+        must fail loudly (data loss), not quietly proceed without the tag."""
+        import pytest
+
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        c = RecoverableCluster(
+            seed=63, n_storage_shards=3, n_tlogs=4, durable=True
+        )
+        db = c.database()
+
+        async def write_phase():
+            tr = db.create_transaction()
+            tr.set(b"\xcchigh", b"v")  # shard 2 -> tag ss-2 -> slots {2,3}
+            await tr.commit()
+
+        c.run_until(c.loop.spawn(write_phase()), 60)
+        fs = c.power_off()
+        for path in fs.list("tlog2"):
+            fs.delete(path)
+        for path in fs.list("tlog3"):
+            fs.delete(path)
+        with pytest.raises(Exception, match="ss-2.*data loss|lost cstate|data loss"):
+            RecoverableCluster(
+                seed=64, n_storage_shards=3, n_tlogs=4, fs=fs, restart=True
+            )

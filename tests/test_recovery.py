@@ -85,3 +85,100 @@ def test_recovery_determinism():
         return out
 
     assert once() == once()
+
+
+def test_fence_aborts_zombie_original():
+    """The unknown-result fence property (NativeAPI.actor.cpp:2482-2502):
+    once the fence commits, an in-flight 'zombie' commit whose read snapshot
+    predates it can NEVER land — its read set conflicts with the fence's
+    write set."""
+    from foundationdb_tpu.cluster import SimCluster
+    from foundationdb_tpu.roles.types import NotCommitted
+
+    c = SimCluster(seed=71)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"ctr", b"0")
+        await tr.commit()
+        # the 'original': reads ctr, writes ctr, but its commit is delayed
+        zombie = db.create_transaction()
+        v = int(await zombie.get(b"ctr"))
+        zombie.set(b"ctr", b"%d" % (v + 1))
+        # the fence lands first (what on_error does after unknown result)
+        await zombie._commit_fence(b"ctr")
+        # the zombie arrives late: it must abort, not double-apply
+        try:
+            await zombie.commit()
+            return "committed"
+        except NotCommitted:
+            tr2 = db.create_transaction()
+            return await tr2.get(b"ctr")
+
+    assert c.run_until(c.loop.spawn(main()), 60) == b"0"
+    c.stop()
+
+
+def test_unknown_result_exactly_once_increment():
+    """Kill the proxy mid-commit; the client sees CommitUnknownResult,
+    fences via on_error, then VERIFIES by re-reading before retrying — the
+    fence guarantees the read's answer is final.  The counter ends at
+    exactly initial+1 whichever side of the commit the kill landed on."""
+    from foundationdb_tpu.roles.types import CommitUnknownResult, NotCommitted
+    from foundationdb_tpu.runtime.core import TimedOut
+
+    for kill_delay in (0.001, 0.05, 0.4):
+        c = RecoverableCluster(seed=72, n_storage_shards=2)
+        db = c.database()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set(b"ctr", b"100")
+            await tr.commit()
+
+            tr = db.create_transaction()
+            val = int(await tr.get(b"ctr"))
+            tr.set(b"ctr", b"%d" % (val + 1))
+
+            async def attempt():
+                try:
+                    await tr.commit()
+                    return "committed"
+                except (CommitUnknownResult, TimedOut):
+                    return "unknown"
+                except NotCommitted:
+                    return "aborted"
+
+            async def get_retry(t, key):
+                while True:
+                    try:
+                        return await t.get(key)
+                    except TimedOut as e:  # recovery window: retry the read
+                        await t.on_error(e)
+
+            task = c.loop.spawn(attempt())
+            await c.loop.delay(kill_delay)
+            c.controller.generation.proxy.commit_stream._process.kill()
+            outcome = await task
+            if outcome == "unknown":
+                await tr.on_error(CommitUnknownResult())
+                seen = int(await get_retry(tr, b"ctr"))
+                if seen == val:  # original provably did not land: retry once
+                    tr.set(b"ctr", b"%d" % (val + 1))
+                    while True:
+                        try:
+                            await tr.commit()
+                            break
+                        except (CommitUnknownResult, TimedOut, NotCommitted):
+                            await tr.on_error(CommitUnknownResult())
+                            seen = int(await get_retry(tr, b"ctr"))
+                            if seen != val:
+                                break
+                            tr.set(b"ctr", b"%d" % (val + 1))
+            tr3 = db.create_transaction()
+            return await get_retry(tr3, b"ctr")
+
+        final = c.run_until(c.loop.spawn(main()), 300)
+        assert final == b"101", f"kill_delay={kill_delay}: got {final}"
+        c.stop()
