@@ -32,7 +32,10 @@ class RecoverableCluster:
         n_coordinators: int = 3,
         conflict_backend: Callable[..., object] | None = None,
         knobs: CoreKnobs | None = None,
-        durable: bool = False,  # disk-backed TLogs/storage/coordinators
+        durable: bool = True,   # disk-backed TLogs/storage/coordinators
+                                # (the DEFAULT, as in the reference: every
+                                # simulation runs the durability model;
+                                # durable=False is for conflict benches only)
         fs=None,                # SimFilesystem to reuse (cluster restart)
         restart: bool = False,  # bootstrap from fs contents
     ) -> None:

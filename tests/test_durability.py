@@ -227,3 +227,98 @@ class TestClusterRestart:
             return out, epoch
 
         assert once() == once()
+
+
+class TestChaosPowerLoss:
+    def _ring_ok(self, rows, nodes):
+        kv = dict(rows)
+        if len(kv) != nodes:
+            return False
+        nxt = {int(k.split(b"/")[1]): int(v) for k, v in kv.items()}
+        seen, cur = set(), 0
+        for _ in range(nodes):
+            if cur in seen:
+                return False
+            seen.add(cur)
+            cur = nxt[cur]
+        return cur == 0
+
+    def test_power_loss_mid_recovery_mid_cycle(self):
+        """The chaos combination: Cycle running, a proxy kill triggers a
+        generation recovery, and the WHOLE cluster loses power while that
+        recovery is still in flight.  Restart from files: the ring invariant
+        holds over the committed prefix (no half-applied rotation, no lost
+        acked commit)."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+        nodes = 8
+        c = RecoverableCluster(seed=47, n_storage_shards=2, n_resolvers=2)
+        cyc = CycleWorkload(nodes=nodes, clients=3, txns_per_client=1000)
+        rng = c.rng.split()
+
+        async def chaos():
+            await cyc.setup(c, rng.split())
+            c.loop.spawn(cyc.start(c, rng.split()))
+            await c.loop.delay(1.0)  # let rotations commit
+            c.controller.generation.proxy.commit_stream._process.kill()
+            for _ in range(10_000):  # wait for recovery to BEGIN
+                if c.controller._recovering:
+                    return
+                await c.loop.delay(0.01)
+            raise AssertionError("recovery never started")
+
+        c.run_until(c.loop.spawn(chaos()), 120)
+        assert cyc.committed > 0, "no rotations committed before the chaos"
+        fs = c.power_off()
+
+        c2 = RecoverableCluster(seed=48, n_storage_shards=2, n_resolvers=2,
+                                fs=fs, restart=True)
+        db2 = c2.database()
+
+        async def check():
+            tr = db2.create_transaction()
+            rows = await tr.get_range(b"cycle/", b"cycle0", limit=1000)
+            # and the cluster still accepts commits after the chaos
+            tr2 = db2.create_transaction()
+            tr2.set(b"alive", b"1")
+            await tr2.commit()
+            return rows
+
+        rows = c2.run_until(c2.loop.spawn(check()), 120)
+        assert self._ring_ok(rows, nodes), f"ring broken: {sorted(rows)}"
+        c2.stop()
+
+    def test_power_loss_sweep_over_kill_offsets(self):
+        """Sweep the power-loss instant across the recovery window (several
+        offsets after the proxy kill): every restart must keep the ring."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+        nodes = 6
+        for offset in (0.0, 0.05, 0.2, 1.0, 3.0):
+            c = RecoverableCluster(seed=49, n_storage_shards=2)
+            cyc = CycleWorkload(nodes=nodes, clients=2, txns_per_client=1000)
+            rng = c.rng.split()
+
+            async def chaos():
+                await cyc.setup(c, rng.split())
+                c.loop.spawn(cyc.start(c, rng.split()))
+                await c.loop.delay(0.8)
+                c.controller.generation.proxy.commit_stream._process.kill()
+                await c.loop.delay(offset)
+
+            c.run_until(c.loop.spawn(chaos()), 120)
+            assert cyc.committed > 0, f"offset={offset}: nothing committed"
+            fs = c.power_off()
+            c2 = RecoverableCluster(seed=50, n_storage_shards=2,
+                                    fs=fs, restart=True)
+            db2 = c2.database()
+
+            async def check():
+                tr = db2.create_transaction()
+                return await tr.get_range(b"cycle/", b"cycle0", limit=1000)
+
+            rows = c2.run_until(c2.loop.spawn(check()), 120)
+            assert self._ring_ok(rows, nodes), f"offset={offset}: ring broken"
+            c2.stop()
