@@ -62,17 +62,23 @@ class ClusterView:
     """The client's window onto the current cluster generation — the
     MonitorLeader/cluster-file analog.  The control plane mutates these
     attributes on recovery; every Transaction reads them per call, so
-    clients follow failovers without restarting."""
+    clients follow failovers without restarting.
+
+    `grvs`/`commits` hold one ref per proxy; clients spread load across
+    them (the reference load-balances MasterProxyInterface the same way)."""
 
     def __init__(
         self,
-        grv_ref: RequestStreamRef,
-        commit_ref: RequestStreamRef,
+        grv_refs: list[RequestStreamRef] | RequestStreamRef | None,
+        commit_refs: list[RequestStreamRef] | RequestStreamRef | None,
         storage_map: KeyPartitionMap,  # members: {"getvalue": ref, "getkeyvalues": ref}
         epoch: int = 0,
     ) -> None:
-        self.grv = grv_ref
-        self.commit = commit_ref
+        def as_list(x):
+            return x if isinstance(x, list) or x is None else [x]
+
+        self.grvs = as_list(grv_refs)
+        self.commits = as_list(commit_refs)
         self.smap = storage_map
         self.epoch = epoch
 
@@ -90,11 +96,11 @@ class Database:
 
     @property
     def _grv(self) -> RequestStreamRef:
-        return self.view.grv
+        return self._rng.random_choice(self.view.grvs)
 
     @property
     def _commit(self) -> RequestStreamRef:
-        return self.view.commit
+        return self._rng.random_choice(self.view.commits)
 
     @property
     def _smap(self) -> KeyPartitionMap:

@@ -126,8 +126,8 @@ class SimCluster:
             for ss in self.storage
         ]
         view = ClusterView(
-            grv_ref=self._ref(proc, self.proxy.grv_stream.endpoint),
-            commit_ref=self._ref(proc, self.proxy.commit_stream.endpoint),
+            grv_refs=[self._ref(proc, self.proxy.grv_stream.endpoint)],
+            commit_refs=[self._ref(proc, self.proxy.commit_stream.endpoint)],
             storage_map=KeyPartitionMap(self.storage_splits, storage_members),
         )
         return Database(self.loop, view, self.rng)

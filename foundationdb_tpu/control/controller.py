@@ -61,11 +61,16 @@ class RecoveryState:
 class GenerationRoles:
     epoch: int
     sequencer: Sequencer
-    proxy: CommitProxy
+    proxies: list[CommitProxy]
     resolvers: list[Resolver]
     tlogs: list[TLog]
     processes: list[SimProcess]
     ping_tasks: list = dataclasses.field(default_factory=list)
+
+    @property
+    def proxy(self) -> CommitProxy:
+        """First proxy (single-proxy-era call sites and chaos tests)."""
+        return self.proxies[0]
 
 
 class ClusterController:
@@ -83,6 +88,7 @@ class ClusterController:
         conflict_backend: Callable[..., ConflictSet],
         resolver_splits: list[bytes],
         n_tlogs: int = 2,
+        n_proxies: int = 1,
         cstate=None,  # CoordinatedState or None (tests without coordinators)
         fs=None,      # SimFilesystem: TLogs become disk-backed
         restart: bool = False,  # bootstrap generation 1 from on-disk TLogs
@@ -97,6 +103,7 @@ class ClusterController:
         self.resolver_splits = resolver_splits
         self.make_cs = conflict_backend
         self.n_tlogs = n_tlogs
+        self.n_proxies = n_proxies
         self.cstate = cstate
         self.fs = fs
         self.restart = restart
@@ -355,30 +362,48 @@ class ClusterController:
                 )
             )
 
-        proxy_proc = self._new_proc("proxy")
-        procs.append(proxy_proc)
-        add_ping(proxy_proc)
         tags = [f"ss-{i}" for i in range(len(self.storage_splits) + 1)]
-        proxy = CommitProxy(
-            proxy_proc, self.loop, self.knobs,
-            sequencer_ref=RequestStreamRef(self.net, proxy_proc, sequencer.stream.endpoint),
-            resolver_refs=[
-                RequestStreamRef(self.net, proxy_proc, r.stream.endpoint)
-                for r in resolvers
-            ],
-            resolver_splits=self.resolver_splits,
-            tlog_refs=[
-                RequestStreamRef(self.net, proxy_proc, t.commit_stream.endpoint)
-                for t in tlogs
-            ],
-            storage_tags=KeyPartitionMap(self.storage_splits, tags),
-            tag_to_tlogs={t: self._tag_tlogs(t) for t in tags},
-            start_version=recovery_version + 1_000_000,
-        )
-        proxy.ratekeeper = self.ratekeeper
-        proxy.on_commit_failure = self._on_proxy_failure
+        proxies: list[CommitProxy] = []
+        for i in range(self.n_proxies):
+            proxy_proc = self._new_proc(f"proxy{i}")
+            procs.append(proxy_proc)
+            add_ping(proxy_proc)
+            proxy = CommitProxy(
+                proxy_proc, self.loop, self.knobs,
+                sequencer_ref=RequestStreamRef(self.net, proxy_proc, sequencer.stream.endpoint),
+                resolver_refs=[
+                    RequestStreamRef(self.net, proxy_proc, r.stream.endpoint)
+                    for r in resolvers
+                ],
+                resolver_splits=self.resolver_splits,
+                tlog_refs=[
+                    RequestStreamRef(self.net, proxy_proc, t.commit_stream.endpoint)
+                    for t in tlogs
+                ],
+                storage_tags=KeyPartitionMap(self.storage_splits, tags),
+                tag_to_tlogs={t: self._tag_tlogs(t) for t in tags},
+                start_version=recovery_version + 1_000_000,
+                tlog_confirm_refs=[
+                    RequestStreamRef(self.net, proxy_proc, t.confirm_stream.endpoint)
+                    for t in tlogs
+                ],
+            )
+            proxy.ratekeeper = self.ratekeeper
+            proxy.on_commit_failure = self._on_proxy_failure
+            proxies.append(proxy)
+        # mutual raw-version refs: each proxy's GRV takes the max over all
+        # proxies' committed versions (getLiveCommittedVersion :1002)
+        for p in proxies:
+            p.peers = [
+                RequestStreamRef(
+                    self.net, p.commit_stream._process,
+                    q.raw_version_stream.endpoint,
+                )
+                for q in proxies
+                if q is not p
+            ]
         return GenerationRoles(
-            self.epoch, sequencer, proxy, resolvers, tlogs, procs, ping_tasks
+            self.epoch, sequencer, proxies, resolvers, tlogs, procs, ping_tasks
         )
 
     def _rewire(self, gen: GenerationRoles, recovery_version: Version | None = None) -> None:

@@ -27,12 +27,15 @@ from .types import (
     CommitTransactionRequest,
     GetCommitVersionReply,
     GetCommitVersionRequest,
+    GetRawCommittedVersionReply,
+    GetRawCommittedVersionRequest,
     GetReadVersionReply,
     GetReadVersionRequest,
     Mutation,
     MutationType,
     ResolveTransactionBatchRequest,
     TLogCommitRequest,
+    TLogConfirmRequest,
     Version,
 )
 from ..rpc.network import SimProcess
@@ -81,6 +84,7 @@ class _PendingCommit:
 class CommitProxy:
     WLT_COMMIT = "wlt:proxy_commit"
     WLT_GRV = "wlt:proxy_grv"
+    WLT_RAW = "wlt:proxy_rawversion"
 
     def __init__(
         self,
@@ -94,6 +98,7 @@ class CommitProxy:
         storage_tags: KeyPartitionMap,
         tag_to_tlogs: dict[str, list[int]] | None = None,
         start_version: Version = 0,
+        tlog_confirm_refs: list[RequestStreamRef] | None = None,
     ) -> None:
         self.loop = loop
         self.knobs = knobs
@@ -113,8 +118,16 @@ class CommitProxy:
         self._failed = False
         self._grv_tokens = 10.0
         self._grv_refill_at = loop.now()
+        # multi-proxy plane: raw-version refs of the OTHER proxies (wired by
+        # the controller after all proxies exist) and confirm refs to this
+        # generation's TLogs.  With peers, GRV = max over all proxies'
+        # committed versions, confirmed live against the TLogs
+        # (getLiveCommittedVersion, MasterProxyServer.actor.cpp:1002).
+        self.peers: list[RequestStreamRef] = []
+        self.tlog_confirms = tlog_confirm_refs or []
         self.commit_stream = RequestStream(process, self.WLT_COMMIT)
         self.grv_stream = RequestStream(process, self.WLT_GRV)
+        self.raw_version_stream = RequestStream(process, self.WLT_RAW)
         self.counters = CounterCollection("Proxy")
         self.c_committed = self.counters.counter("txns_committed")
         self.c_conflicted = self.counters.counter("txns_conflicted")
@@ -125,6 +138,8 @@ class CommitProxy:
             loop.spawn(self._accept_commits(), TaskPriority.PROXY_COMMIT, "proxy-accept"),
             loop.spawn(self._batcher(), TaskPriority.PROXY_COMMIT, "proxy-batcher"),
             loop.spawn(self._grv_server(), TaskPriority.GET_LIVE_VERSION, "proxy-grv"),
+            loop.spawn(self._raw_version_server(), TaskPriority.GET_LIVE_VERSION,
+                       "proxy-raw"),
         ]
 
     # -- phase 1: batching --------------------------------------------------
@@ -244,6 +259,24 @@ class CommitProxy:
             for i in range(len(batch))
         ]
 
+        # phase 4 precondition — the MVCC-window commit throttle (:850-870):
+        # storage servers must never be handed durable versions that are not
+        # fully committed, so the semi-committed span (this batch's version
+        # minus the newest fully-committed version) is capped at the MVCC
+        # window.  Rare in healthy clusters; bites when storage/logging lag.
+        window = self.knobs.mvcc_window_versions
+        while self.committed_version.get() < version - window:
+            await wait_any(
+                [
+                    self.committed_version.when_at_least(version - window),
+                    self.loop.delay(0.05, TaskPriority.PROXY_COMMIT),
+                ]
+            )
+            if self.committed_version.get() < version - window:
+                await self._refresh_committed_from_peers()
+                if self._failed or self.loop.now() >= deadline:
+                    raise TimedOut("MVCC-window throttle never cleared")
+
         # phase 4: tag committed mutations, push to TLogs
         by_tag: dict[str, list[Mutation]] = {}
         for pc, v in zip(batch, verdicts):
@@ -281,8 +314,13 @@ class CommitProxy:
             ]
         )
 
-        # phase 5: advance committed version in order, reply
-        await self.committed_version.when_at_least(prev_v)
+        # phase 5: publish + reply.  No local wait on prev_v: the global
+        # prev->version chain is enforced AT the TLogs (each waits for its
+        # version to reach prev before appending, syncs before acking), so
+        # all-TLogs-acked(version) already implies every version <= this one
+        # — including other proxies' — is durable everywhere.  A later
+        # version may legitimately be reported committed first (reference
+        # TEST at :943).
         if self.committed_version.get() < version:
             self.committed_version.set(version)
         for pc, v in zip(batch, verdicts):
@@ -296,33 +334,114 @@ class CommitProxy:
                 pc.reply_cb.reply(CommitReply(CommitResult.NOT_COMMITTED))
 
     # -- GRV ------------------------------------------------------------------
-    def _refill_grv_tokens(self) -> None:
+    def _refill_grv_tokens(self, share: int = 1) -> None:
         now = self.loop.now()
         rate = self.ratekeeper.tps_budget if self.ratekeeper else float("inf")
+        rate /= max(share, 1)  # each proxy spends its slice of the budget
         self._grv_tokens = min(
             self._grv_tokens + (now - self._grv_refill_at) * rate,
             max(rate * 0.1, 100.0),
         )
         self._grv_refill_at = now
 
+    async def _raw_version_server(self) -> None:
+        """Peer service: this proxy's committed version, no liveness check
+        (GetRawCommittedVersionRequest)."""
+        while True:
+            req = await self.raw_version_stream.next()
+            assert isinstance(req.payload, GetRawCommittedVersionRequest)
+            req.reply(GetRawCommittedVersionReply(self.committed_version.get()))
+
+    async def _refresh_committed_from_peers(self) -> None:
+        """Pull peers' committed versions and advance ours to the max (the
+        periphery of getLiveCommittedVersion; also un-stalls the MVCC
+        throttle when another proxy has committed past us)."""
+        if not self.peers:
+            return
+        replies = await wait_all(
+            [
+                self.loop.spawn(
+                    self._try_raw(p), TaskPriority.GET_LIVE_VERSION
+                )
+                for p in self.peers
+            ]
+        )
+        best = max(
+            (r.version for r in replies if r is not None),
+            default=0,
+        )
+        if best > self.committed_version.get():
+            self.committed_version.set(best)
+
+    async def _try_raw(self, peer: RequestStreamRef):
+        try:
+            return await peer.get_reply(
+                GetRawCommittedVersionRequest(), timeout=0.5
+            )
+        except TimedOut:
+            return None
+
+    async def _confirm_epoch_live(self) -> bool:
+        """All this generation's TLogs answer unlocked (confirmEpochLive).
+        A locked or unreachable TLog means this proxy may be deposed — it
+        must NOT serve a read version (the reply could be stale: a newer
+        generation may have committed past it)."""
+        if not self.tlog_confirms:
+            return True  # statically-wired cluster without the control plane
+        try:
+            replies = await wait_all(
+                [
+                    self.loop.spawn(
+                        ref.get_reply(TLogConfirmRequest(), timeout=0.5),
+                        TaskPriority.GET_LIVE_VERSION,
+                    )
+                    for ref in self.tlog_confirms
+                ]
+            )
+        except TimedOut:
+            return False
+        return not any(r.locked for r in replies)
+
     async def _grv_server(self) -> None:
-        """Batched read-version service (transactionStarter :1052): a read
-        version is the newest committed version — causally safe because
-        committed_version only advances after TLog durability.  Transaction
-        starts spend the ratekeeper's cluster-wide budget (the token bucket
-        the reference feeds from ratekeeper to proxies, :508)."""
+        """Batched read-version service (transactionStarter :1052 +
+        getLiveCommittedVersion :1002): drain the queued GRV requests, spend
+        ratekeeper budget, confirm the epoch is live with the TLogs, take
+        the max committed version across all proxies, reply to the whole
+        batch.  Causally safe because committed versions only advance after
+        all-TLog durability, and the liveness confirmation means no newer
+        generation can have committed anything this proxy hasn't seen."""
         while True:
             req = await self.grv_stream.next()
+            reqs = [req]
+            while len(self.grv_stream.requests):
+                reqs.append(await self.grv_stream.next())
             if self.ratekeeper is not None:
-                self._refill_grv_tokens()
-                while self._grv_tokens < 1.0:
+                share = 1 + len(self.peers)  # budget split across proxies
+                self._refill_grv_tokens(share)
+                while self._grv_tokens < len(reqs):
                     await self.loop.delay(0.005, TaskPriority.GET_LIVE_VERSION)
-                    self._refill_grv_tokens()
-                self._grv_tokens -= 1.0
-            req.reply(GetReadVersionReply(self.committed_version.get()))
+                    self._refill_grv_tokens(share)
+                self._grv_tokens -= len(reqs)
+            live, _ = await wait_all(
+                [
+                    self.loop.spawn(
+                        self._confirm_epoch_live(), TaskPriority.GET_LIVE_VERSION
+                    ),
+                    self.loop.spawn(
+                        self._refresh_committed_from_peers(),
+                        TaskPriority.GET_LIVE_VERSION,
+                    ),
+                ]
+            )
+            if not live:
+                continue  # deposed: never answer; clients re-route on retry
+            version = self.committed_version.get()
+            for r in reqs:
+                r.reply(GetReadVersionReply(version))
 
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
         self.commit_stream.close()
         self.grv_stream.close()
+        self.raw_version_stream.close()

@@ -24,6 +24,7 @@ import bisect
 from .sequencer import NotifiedVersion
 from .types import (
     TLogCommitRequest,
+    TLogConfirmReply,
     TLogLockReply,
     TLogLockRequest,
     TLogPeekReply,
@@ -78,6 +79,7 @@ class TLog:
     WLT_PEEK = "wlt:tlog_peek"
     WLT_POP = "wlt:tlog_pop"
     WLT_LOCK = "wlt:tlog_lock"
+    WLT_CONFIRM = "wlt:tlog_confirm"
 
     def __init__(self, process: SimProcess, loop: EventLoop,
                  start_version: Version = 0, sync_delay: float = 0.0005,
@@ -107,11 +109,13 @@ class TLog:
         self.peek_stream = RequestStream(process, self.WLT_PEEK)
         self.pop_stream = RequestStream(process, self.WLT_POP)
         self.lock_stream = RequestStream(process, self.WLT_LOCK)
+        self.confirm_stream = RequestStream(process, self.WLT_CONFIRM)
         self._tasks = [
             loop.spawn(self._serve_commit(), TaskPriority.TLOG_COMMIT, "tlog-commit"),
             loop.spawn(self._serve_peek(), TaskPriority.TLOG_COMMIT, "tlog-peek"),
             loop.spawn(self._serve_pop(), TaskPriority.TLOG_COMMIT, "tlog-pop"),
             loop.spawn(self._serve_lock(), TaskPriority.TLOG_COMMIT, "tlog-lock"),
+            loop.spawn(self._serve_confirm(), TaskPriority.TLOG_COMMIT, "tlog-confirm"),
         ]
 
     # -- commit ------------------------------------------------------------
@@ -215,6 +219,14 @@ class TLog:
                 TLogLockReply(end_version=self.version.get(), tags=dict(self._tags))
             )
 
+    # -- confirm (GRV liveness) ---------------------------------------------
+    async def _serve_confirm(self) -> None:
+        """Epoch-liveness probe for proxy GRVs (confirmEpochLive): replies
+        the lock state; locked means this generation has ended."""
+        while True:
+            req = await self.confirm_stream.next()
+            req.reply(TLogConfirmReply(locked=self.locked))
+
     async def initial_durable(self) -> None:
         """Await durability of the construction-time RESET record.  A new
         generation's seeds (the surviving data of the previous epoch) must
@@ -265,5 +277,6 @@ class TLog:
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
-        for s in (self.commit_stream, self.peek_stream, self.pop_stream):
+        for s in (self.commit_stream, self.peek_stream, self.pop_stream,
+                  self.confirm_stream):
             s.close()

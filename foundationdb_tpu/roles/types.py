@@ -198,6 +198,30 @@ class TLogLockReply:
     tags: dict  # tag -> list[(version, [Mutation])] unpopped entries
 
 
+@dataclasses.dataclass
+class TLogConfirmRequest:
+    """GRV liveness check (confirmEpochLive, the TLog half of
+    getLiveCommittedVersion, MasterProxyServer.actor.cpp:1002): a TLog
+    replies only with its lock state; a locked reply tells the asking proxy
+    its generation has ended and it must not serve read versions."""
+
+
+@dataclasses.dataclass
+class TLogConfirmReply:
+    locked: bool
+
+
+@dataclasses.dataclass
+class GetRawCommittedVersionRequest:
+    """Proxy-to-proxy: your committed version, no liveness check (the
+    GetRawCommittedVersionRequest of the reference's GRV path)."""
+
+
+@dataclasses.dataclass
+class GetRawCommittedVersionReply:
+    version: Version
+
+
 class ClusterRecovering(Exception):
     """Commit pipeline is between generations; retry shortly."""
 
