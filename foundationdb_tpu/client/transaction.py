@@ -31,18 +31,20 @@ from ..roles.types import (
     Version,
 )
 from ..rpc.stream import RequestStreamRef
-from ..runtime.core import DeterministicRandom, EventLoop, TimedOut
+from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TimedOut
 from ..keys import key_after
 
 # errors a client retry loop may transparently retry (the onError set,
 # NativeAPI.actor.cpp:2543 — not_committed / transaction_too_old /
-# future_version / commit_unknown_result / proxy-unreachable timeouts)
+# future_version / commit_unknown_result / proxy-unreachable timeouts /
+# broken-promise connection resets)
 RETRYABLE_ERRORS = (
     NotCommitted,
     TransactionTooOld,
     FutureVersion,
     CommitUnknownResult,
     TimedOut,
+    BrokenPromise,
 )
 
 
@@ -203,18 +205,39 @@ class Transaction:
                 )
         raise CommitUnknownResult("fence transaction could not commit")
 
+    async def _reply_rerouted(self, pick_ref, payload, timeout: float = 5.0):
+        """get_reply with fast re-route: a BrokenPromise (dead endpoint —
+        the connection-reset analog) retries immediately against a freshly
+        picked ref (the view is re-read, so a recovery's rewire takes
+        effect), the reference's loadBalance/alternatives loop.  Only the
+        overall deadline surfaces, as TimedOut."""
+        loop = self.db.loop
+        deadline = loop.now() + timeout
+        while True:
+            remaining = deadline - loop.now()
+            if remaining <= 0:
+                raise TimedOut(f"timed out after {timeout}s")
+            try:
+                return await pick_ref().get_reply(payload, timeout=remaining)
+            except BrokenPromise:
+                await loop.delay(min(0.05, max(deadline - loop.now(), 0.001)))
+
     # -- read version -------------------------------------------------------
     async def get_read_version(self) -> Version:
         if self._read_version is None:
-            reply = await self.db._grv.get_reply(GetReadVersionRequest(), timeout=5.0)
+            reply = await self._reply_rerouted(
+                lambda: self.db._grv, GetReadVersionRequest()
+            )
             self._read_version = reply.version
         return self._read_version
 
     # -- reads --------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
         v = await self.get_read_version()
-        refs = self.db._smap.member_for_key(key)
-        reply = await refs["getvalue"].get_reply(GetValueRequest(key, v), timeout=5.0)
+        reply = await self._reply_rerouted(
+            lambda: self.db._smap.member_for_key(key)["getvalue"],
+            GetValueRequest(key, v),
+        )
         if not snapshot:
             self._read_ranges.append((key, key_after(key)))
         return reply.value
@@ -231,8 +254,9 @@ class Transaction:
             if clip is None:
                 continue
             b, e = clip
-            reply = await smap.members[idx]["getkeyvalues"].get_reply(
-                GetKeyValuesRequest(b, e, v, limit - len(out)), timeout=5.0
+            reply = await self._reply_rerouted(
+                lambda idx=idx: self.db._smap.members[idx]["getkeyvalues"],
+                GetKeyValuesRequest(b, e, v, limit - len(out)),
             )
             out.extend(reply.data)
             if len(out) >= limit:
@@ -289,6 +313,11 @@ class Transaction:
         except TimedOut:
             # proxy unreachable: the commit may have happened
             raise CommitUnknownResult()
+        except BrokenPromise:
+            # the request was never delivered (proxy dead/stream closed
+            # before delivery): the commit definitely did not start, so a
+            # plain retry is safe — no fence needed
+            raise NotCommitted()
         if reply.result == CommitResult.COMMITTED:
             self.committed_version = reply.version
             return reply.version

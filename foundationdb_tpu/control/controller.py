@@ -41,7 +41,7 @@ from ..roles.types import TLogLockReply, TLogLockRequest, Version
 from ..rpc.network import Endpoint, SimNetwork, SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all, wait_any
-from ..runtime.core import DeterministicRandom, EventLoop, TaskPriority, TimedOut
+from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
 
@@ -174,6 +174,13 @@ class ClusterController:
                     p.kill()  # old roles may not serve a split-brain
                 for t in old.ping_tasks:
                     t.cancel()
+                # cancel the deposed roles' tasks too: a killed process stops
+                # receiving, but its Python tasks would otherwise spin (the
+                # GRV park loop retries forever against locked/dead TLogs)
+                for role in (
+                    [old.sequencer] + old.proxies + old.resolvers + old.tlogs
+                ):
+                    role.stop()
             gen = self._recruit(recovery_version, tag_data)
             # durable-seed barrier: the new TLogs' RESET records (carrying
             # every surviving committed byte) must be on disk before the
@@ -219,7 +226,7 @@ class ClusterController:
             ref = RequestStreamRef(self.net, self._cc_proc(), t.lock_stream.endpoint)
             try:
                 replies.append(await ref.get_reply(TLogLockRequest(), timeout=1.0))
-            except TimedOut:
+            except (TimedOut, BrokenPromise):
                 replies.append(None)  # that TLog is gone
         alive = [r for r in replies if r is not None]
         if not alive:
@@ -423,8 +430,14 @@ class ClusterController:
     def _fill_view(self, view: ClusterView) -> None:
         gen = self.generation
         client_proc = view._client_proc
-        view.grv = RequestStreamRef(self.net, client_proc, gen.proxy.grv_stream.endpoint)
-        view.commit = RequestStreamRef(self.net, client_proc, gen.proxy.commit_stream.endpoint)
+        view.grvs = [
+            RequestStreamRef(self.net, client_proc, p.grv_stream.endpoint)
+            for p in gen.proxies
+        ]
+        view.commits = [
+            RequestStreamRef(self.net, client_proc, p.commit_stream.endpoint)
+            for p in gen.proxies
+        ]
         view.smap = KeyPartitionMap(
             self.storage_splits,
             [
@@ -450,7 +463,7 @@ class ClusterController:
         between proxy and resolver that heartbeats can't see): its assigned
         versions may be chain holes, so the generation must end."""
         gen = self.generation
-        if gen is None or proxy is not gen.proxy or self._recovering:
+        if gen is None or proxy not in gen.proxies or self._recovering:
             return
         self.trace.trace(
             "ProxyCommitPathFailure", Error=repr(exc), Epoch=self.epoch
@@ -481,7 +494,7 @@ class ClusterController:
                 ref = RequestStreamRef(self.net, cc, Endpoint(p.address, "wlt:ping"))
                 try:
                     await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
-                except TimedOut:
+                except (TimedOut, BrokenPromise):
                     dead.append(p.name)
             if dead and self.generation is gen:
                 self.trace.trace(

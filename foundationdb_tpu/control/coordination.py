@@ -167,14 +167,31 @@ class CoordinatedState:
         return len(self._reads) // 2 + 1
 
     async def _majority(self, futures: list[Future]) -> list:
-        """Collect replies until a majority succeeded (ignores the rest)."""
+        """Collect replies until a majority succeeded.  Individual failures
+        (dead coordinator → BrokenPromise, unreachable → TimedOut) are
+        skipped; the call fails only when a majority can no longer succeed."""
         need = self.quorum_size
         got: list = []
-        pending = list(futures)
+        failures = 0
+        pending: list[Future] = []
+        for f in futures:
+            p = Promise()
+
+            def settle(fut: Future, p=p) -> None:
+                err = fut.exception()
+                p.send((False, err) if err is not None else (True, fut.result()))
+
+            f.add_done_callback(settle)
+            pending.append(p.future)
         while pending and len(got) < need:
-            idx, result = await wait_any(pending)
-            got.append(result)
+            idx, (ok, result) = await wait_any(pending)
             pending.pop(idx)
+            if ok:
+                got.append(result)
+            else:
+                failures += 1
+                if failures > len(futures) - need:
+                    raise TimedOut("no coordinator quorum")
         if len(got) < need:
             raise TimedOut("no coordinator quorum")
         return got

@@ -40,8 +40,8 @@ from .types import (
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
-from ..runtime.combinators import wait_all
-from ..runtime.core import EventLoop, FutureStream, TaskPriority, TimedOut
+from ..runtime.combinators import wait_all, wait_any
+from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import CounterCollection
 
@@ -185,7 +185,7 @@ class CommitProxy:
         while True:
             try:
                 return await ref.get_reply(payload, timeout=1.0)
-            except TimedOut:
+            except (TimedOut, BrokenPromise):
                 attempt += 1
                 if self._failed or self.loop.now() >= deadline:
                     raise
@@ -352,12 +352,19 @@ class CommitProxy:
             assert isinstance(req.payload, GetRawCommittedVersionRequest)
             req.reply(GetRawCommittedVersionReply(self.committed_version.get()))
 
-    async def _refresh_committed_from_peers(self) -> None:
+    async def _refresh_committed_from_peers(self) -> bool:
         """Pull peers' committed versions and advance ours to the max (the
         periphery of getLiveCommittedVersion; also un-stalls the MVCC
-        throttle when another proxy has committed past us)."""
+        throttle when another proxy has committed past us).
+
+        Returns True only if EVERY peer answered.  A GRV must not be served
+        from a partial refresh: an unreachable peer may hold a newer
+        committed version than ours, and answering without it would hand a
+        client a read version older than its own acknowledged write (the
+        reference broadcasts GetRawCommittedVersion to ALL proxies and
+        waits, MasterProxyServer.actor.cpp:1002)."""
         if not self.peers:
-            return
+            return True
         replies = await wait_all(
             [
                 self.loop.spawn(
@@ -372,13 +379,14 @@ class CommitProxy:
         )
         if best > self.committed_version.get():
             self.committed_version.set(best)
+        return all(r is not None for r in replies)
 
     async def _try_raw(self, peer: RequestStreamRef):
         try:
             return await peer.get_reply(
                 GetRawCommittedVersionRequest(), timeout=0.5
             )
-        except TimedOut:
+        except (TimedOut, BrokenPromise):
             return None
 
     async def _confirm_epoch_live(self) -> bool:
@@ -388,17 +396,18 @@ class CommitProxy:
         generation may have committed past it)."""
         if not self.tlog_confirms:
             return True  # statically-wired cluster without the control plane
+
+        async def confirm(ref: RequestStreamRef):
+            return await ref.get_reply(TLogConfirmRequest(), timeout=0.5)
+
         try:
             replies = await wait_all(
                 [
-                    self.loop.spawn(
-                        ref.get_reply(TLogConfirmRequest(), timeout=0.5),
-                        TaskPriority.GET_LIVE_VERSION,
-                    )
+                    self.loop.spawn(confirm(ref), TaskPriority.GET_LIVE_VERSION)
                     for ref in self.tlog_confirms
                 ]
             )
-        except TimedOut:
+        except (TimedOut, BrokenPromise):
             return False
         return not any(r.locked for r in replies)
 
@@ -422,19 +431,26 @@ class CommitProxy:
                     await self.loop.delay(0.005, TaskPriority.GET_LIVE_VERSION)
                     self._refill_grv_tokens(share)
                 self._grv_tokens -= len(reqs)
-            live, _ = await wait_all(
-                [
-                    self.loop.spawn(
-                        self._confirm_epoch_live(), TaskPriority.GET_LIVE_VERSION
-                    ),
-                    self.loop.spawn(
-                        self._refresh_committed_from_peers(),
-                        TaskPriority.GET_LIVE_VERSION,
-                    ),
-                ]
-            )
-            if not live:
-                continue  # deposed: never answer; clients re-route on retry
+            while True:
+                live, refreshed = await wait_all(
+                    [
+                        self.loop.spawn(
+                            self._confirm_epoch_live(), TaskPriority.GET_LIVE_VERSION
+                        ),
+                        self.loop.spawn(
+                            self._refresh_committed_from_peers(),
+                            TaskPriority.GET_LIVE_VERSION,
+                        ),
+                    ]
+                )
+                if live and refreshed:
+                    break
+                # Park, don't drop: the TLogs may be transiently unreachable
+                # (recovery in flight).  If this proxy is genuinely deposed its
+                # tasks are cancelled by stop() and the waiting clients time
+                # out and re-route; answering here with a stale version would
+                # break causality (ref MasterProxyServer.actor.cpp:1002).
+                await self.loop.delay(0.05, TaskPriority.GET_LIVE_VERSION)
             version = self.committed_version.get()
             for r in reqs:
                 r.reply(GetReadVersionReply(version))

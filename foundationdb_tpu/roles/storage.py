@@ -36,7 +36,7 @@ from .types import (
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
-from ..runtime.core import EventLoop, TaskPriority, TimedOut
+from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 
 
@@ -224,7 +224,7 @@ class StorageServer:
                 reply = await self.tlog.get_reply(
                     TLogPeekRequest(self.tag, self._fetched + 1), timeout=1.0
                 )
-            except TimedOut:
+            except (TimedOut, BrokenPromise):
                 # TLog down or unreachable (kill/clog/partition): back off
                 # and retry — the pull loop must survive transient faults
                 await self.loop.delay(0.1, TaskPriority.STORAGE_SERVER)

@@ -185,7 +185,26 @@ class SimNetwork:
             proc = self.processes.get(dst)
             if proc is None or not proc.alive:
                 self.messages_dropped += 1
+                self._break_reply(dst, msg)
+                return
+            if endpoint.token not in proc._endpoints:
+                # closed/never-registered stream: fail the caller fast (the
+                # TCP connection-reset analog) instead of leaving it to burn
+                # its full timeout — the reference's clients see
+                # broken_promise the moment the connection drops
+                self.messages_dropped += 1
+                self._break_reply(dst, msg)
                 return
             proc._deliver(endpoint.token, msg)
 
         self.loop._at(when, TaskPriority.DEFAULT_ENDPOINT, deliver)
+
+    def _break_reply(self, dead_dst: NetworkAddress, msg: Any) -> None:
+        """If `msg` was an RPC expecting a reply, route BrokenPromise back to
+        the caller (unless the caller itself is unreachable)."""
+        reply_to = getattr(msg, "reply_to", None)
+        if reply_to is None:
+            return
+        from .stream import RpcError  # local: stream.py imports this module
+
+        self.send(dead_dst, reply_to, RpcError(BrokenPromise("endpoint gone")))

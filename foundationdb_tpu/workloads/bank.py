@@ -25,10 +25,12 @@ class BankWorkload(Workload):
 
     async def setup(self, cluster, rng) -> None:
         db = cluster.database()
-        tr = db.create_transaction()
-        for i in range(self.accounts):
-            tr.set(_acct(i), str(self.initial).encode())
-        await tr.commit()
+
+        async def fill(tr):
+            for i in range(self.accounts):
+                tr.set(_acct(i), str(self.initial).encode())
+
+        await db.run(fill)
 
     async def start(self, cluster, rng) -> None:
         db = cluster.database()
@@ -56,8 +58,7 @@ class BankWorkload(Workload):
 
     async def check(self, cluster, rng) -> bool:
         db = cluster.database()
-        tr = db.create_transaction()
-        rows = await tr.get_range(b"bank/", b"bank0")
+        rows = await db.run(lambda tr: tr.get_range(b"bank/", b"bank0"))
         total = sum(int(v) for _k, v in rows)
         return len(rows) == self.accounts and total == self.accounts * self.initial
 

@@ -31,10 +31,12 @@ class CycleWorkload(Workload):
 
     async def setup(self, cluster, rng) -> None:
         db = cluster.database()
-        tr = db.create_transaction()
-        for i in range(self.nodes):
-            tr.set(_key(i), b"%d" % ((i + 1) % self.nodes))
-        await tr.commit()
+
+        async def fill(tr):
+            for i in range(self.nodes):
+                tr.set(_key(i), b"%d" % ((i + 1) % self.nodes))
+
+        await db.run(fill)
 
     async def start(self, cluster, rng) -> None:
         db = cluster.database()
@@ -67,18 +69,21 @@ class CycleWorkload(Workload):
 
     async def check(self, cluster, rng) -> bool:
         db = cluster.database()
-        tr = db.create_transaction()
-        seen = set()
-        cur = 0
-        for _ in range(self.nodes):
-            if cur in seen:
-                return False
-            seen.add(cur)
-            nxt = await tr.get(_key(cur))
-            if nxt is None:
-                return False
-            cur = int(nxt)
-        return cur == 0 and len(seen) == self.nodes
+
+        async def walk(tr):
+            seen = set()
+            cur = 0
+            for _ in range(self.nodes):
+                if cur in seen:
+                    return False
+                seen.add(cur)
+                nxt = await tr.get(_key(cur))
+                if nxt is None:
+                    return False
+                cur = int(nxt)
+            return cur == 0 and len(seen) == self.nodes
+
+        return await db.run(walk)
 
     def metrics(self) -> dict:
         return {"committed": self.committed, "retries": self.retries}

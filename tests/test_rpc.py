@@ -6,7 +6,7 @@ import pytest
 
 from foundationdb_tpu.rpc.network import SimNetwork
 from foundationdb_tpu.rpc.stream import RequestStream, RequestStreamRef
-from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop, TimedOut
+from foundationdb_tpu.runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TimedOut
 
 
 @dataclasses.dataclass
@@ -73,7 +73,9 @@ def test_error_reply():
         loop.run_until(ref.get_reply(Echo("x", [])))
 
 
-def test_dead_server_drops_and_timeout_fires():
+def test_dead_server_fails_fast_with_broken_promise():
+    """A request to a dead process fails the caller quickly (the TCP
+    connection-reset analog) instead of burning its full timeout."""
     loop, net = make_world()
     server = net.create_process("server")
     client = net.create_process("client")
@@ -81,9 +83,24 @@ def test_dead_server_drops_and_timeout_fires():
     ref = RequestStreamRef(net, client, rs.endpoint)
     server.kill()
     fut = ref.get_reply(Echo("x", []), timeout=1.0)
-    with pytest.raises(TimedOut):
+    with pytest.raises(BrokenPromise):
         loop.run_until(fut)
     assert net.messages_dropped == 1
+    assert loop.now() < 1.0  # failed fast, well before the timeout
+
+
+def test_partitioned_server_times_out():
+    """A partition (message silently lost in the network) cannot produce a
+    fast failure — only the caller's timeout fires."""
+    loop, net = make_world()
+    server = net.create_process("server")
+    client = net.create_process("client")
+    rs = RequestStream(server, "wlt:echo")
+    ref = RequestStreamRef(net, client, rs.endpoint)
+    net.partition(server.address, client.address)
+    fut = ref.get_reply(Echo("x", []), timeout=1.0)
+    with pytest.raises(TimedOut):
+        loop.run_until(fut)
 
 
 def test_partition_and_heal():
