@@ -1,0 +1,60 @@
+"""Replicates bench.py's timed region with proper tunnel-safe timing:
+fetch ONE scalar that depends on the batch verdict, never whole arrays.
+Also reports phase-2 fixpoint iteration counts per batch."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import bench as B
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    rng = np.random.default_rng(B.SEED)
+    pool = B.gen_pool(rng)
+    pool_words = B.pool_to_words(pool)
+    versions = iter(range(1, 10_000))
+    prefill = [B.gen_batch(rng, pool, next(versions)) for _ in range(B.PREFILL_BATCHES)]
+    timed = [B.gen_batch(rng, pool, next(versions)) for _ in range(4)]
+
+    dev = DeviceConflictSet(max_key_bytes=B.MAX_KEY_BYTES, capacity=B.CAP)
+    print("prefilling...", flush=True)
+    t0 = time.perf_counter()
+    for b in prefill:
+        dev.resolve_arrays(b["version"], *B.device_pack(pool_words, b, B._bucket))
+    print(f"prefill done in {time.perf_counter() - t0:.1f}s, count={dev.boundary_count}", flush=True)
+
+    packed = [
+        (b["version"], jax.device_put(B.device_pack(pool_words, b, B._bucket)))
+        for b in timed
+    ]
+    # force staging: fetch one element of each
+    for _, args in packed:
+        for a in args:
+            np.asarray(a).ravel()[:1]
+
+    # per-batch timing, pipelined like bench, but fetch 1-element slices
+    for v, args in packed:
+        t0 = time.perf_counter()
+        verdict = dev.resolve_arrays(v, *args, sync=False)
+        t1 = time.perf_counter()
+        s = int(jnp.sum(verdict.astype(jnp.int32)))  # scalar fetch => barrier
+        t2 = time.perf_counter()
+        print(
+            f"batch v={v}: dispatch {1e3 * (t1 - t0):.1f} ms, "
+            f"execute+scalar-fetch {1e3 * (t2 - t1):.1f} ms (verdict sum {s})",
+            flush=True,
+        )
+    dev.check_pipelined()
+    print("count after:", dev.boundary_count)
+
+
+if __name__ == "__main__":
+    main()

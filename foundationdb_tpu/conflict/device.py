@@ -129,6 +129,74 @@ def _bucketed_lower_bound(ks, bucket_idx, count, q, iters: int):
     return lo, lo >= hi
 
 
+def phase_search(ks, bucket_idx, count, rb, re_, wb, we, r_ok, w_ok,
+                 search_iters: int):
+    """The ONE state search: every query class concatenated into a single
+    bucketed lower_bound (upper_bound(ks, k) == lower_bound(ks, (words,
+    len+1)): no key can sit strictly between (w, len) and (w, len+1) in the
+    lane encoding).  Returns (g_lo, g_hi, wb_rank, we_rank, converged)."""
+    R, Wn = rb.shape[0], wb.shape[0]
+    rb_plus = rb.at[:, -1].add(1)
+    queries = jnp.concatenate([rb_plus, re_, wb, we], axis=0)
+    q_live = jnp.concatenate([r_ok, r_ok, w_ok, w_ok])
+    ranks, conv = _bucketed_lower_bound(ks, bucket_idx, count, queries, search_iters)
+    converged = ~jnp.any(q_live & ~conv)
+    g_lo = ranks[:R] - 1          # gap containing rb (ks[0]="" <= any key)
+    g_hi = ranks[R : 2 * R]       # first boundary >= re
+    wb_rank = ranks[2 * R : 2 * R + Wn]
+    we_rank = ranks[2 * R + Wn :]
+    return g_lo, g_hi, wb_rank, we_rank, converged
+
+
+def phase_history(vs, g_lo, g_hi, snap, r_idx, r_ok, n_txn: int):
+    """History conflicts (replaces SkipList::detectConflicts :524):
+    range-max of `vs` over each read's covered gaps; conflict iff
+    max > read snapshot."""
+    hist_table = build_sparse_table(vs, jnp.maximum, 0)
+    read_max = query_sparse_table(hist_table, g_lo, g_hi, jnp.maximum, 0)
+    r_hist = r_ok & (read_max > snap[r_idx])
+    return jnp.zeros(n_txn, jnp.int32).at[r_idx].add(r_hist.astype(jnp.int32)) > 0
+
+
+def phase_intra(rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active,
+                hist, n_txn: int):
+    """Intra-batch conflicts (replaces MiniConflictSet :1028-1152).  The
+    reference's ordered bitmask walk is inherently sequential (later txns
+    see earlier *committed* txns' writes); solved as a fixpoint over a dense
+    [R, Wn] overlap predicate evaluated in a batch-local dense rank space —
+    recomputed inside the reduce each iteration, so nothing R×Wn is ever
+    materialized in HBM.  Returns (intra, n_iters)."""
+    B, R, Wn = n_txn, rb.shape[0], wb.shape[0]
+    lr = _local_ranks(jnp.concatenate([rb, re_, wb, we], axis=0))
+    rb_r, re_r = lr[:R], lr[R : 2 * R]
+    wb_r, we_r = lr[2 * R : 2 * R + Wn], lr[2 * R + Wn :]
+    tx_iota = jnp.arange(B, dtype=jnp.int32)
+
+    def _body(state):
+        intra, _, it = state
+        committed = active & ~hist & ~intra
+        w_com = w_ok & committed[w_idx]
+        w_cand = jnp.where(w_com, w_tx, I32_MAX)  # [Wn]
+        ov = (wb_r[None, :] < re_r[:, None]) & (rb_r[:, None] < we_r[None, :])
+        minw = jnp.min(
+            jnp.where(ov, w_cand[None, :], I32_MAX), axis=1
+        )  # earliest committed writer overlapping each read
+        minw = jnp.where(r_ok, minw, I32_MAX)
+        tx_minw = jnp.full(B, I32_MAX, jnp.int32).at[r_idx].min(minw)
+        new_intra = tx_minw < tx_iota  # strictly-earlier committed writer
+        changed = jnp.any(new_intra != intra)
+        return new_intra, changed, it + 1
+
+    def _cond(state):
+        _, changed, it = state
+        return changed & (it < B + 2)
+
+    intra, _, n_iters = jax.lax.while_loop(
+        _cond, _body, (jnp.zeros(B, bool), jnp.asarray(True), jnp.int32(0))
+    )
+    return intra, n_iters
+
+
 def resolve_core(
     ks,  # uint32[CAP, W] sorted boundaries
     vs,  # int32[CAP] gap version offsets
@@ -157,68 +225,27 @@ def resolve_core(
     rank space, and rebuilds the state with scatters + cumsums on the merged
     index domain instead of searching it.
 
-    Returns (verdict, new_ks, new_vs, new_count, new_bucket_idx, converged);
-    `converged` False means a prefix bucket was deeper than 2**search_iters —
+    Returns (verdict, new_ks, new_vs, new_count, new_bucket_idx, converged,
+    ok); `converged` False means a prefix bucket was deeper than 2**search_iters —
     the host replays the same batch with a full-depth search (pure kernel,
     no donation, so replay is exact)."""
-    B, R, Wn = n_txn, n_read, n_write
-    W = ks.shape[1]
+    B = n_txn
     r_ok = r_tx >= 0
     r_idx = jnp.clip(r_tx, 0, B - 1)
     w_ok = (w_tx >= 0) & ~_is_sentinel(wb)
     w_idx = jnp.clip(w_tx, 0, B - 1)
 
     # ---- the ONE state search ------------------------------------------
-    # upper_bound(ks, k) == lower_bound(ks, (words, len+1)): no key can sit
-    # strictly between (w, len) and (w, len+1) in the lane encoding.
-    rb_plus = rb.at[:, -1].add(1)
-    queries = jnp.concatenate([rb_plus, re_, wb, we], axis=0)
-    q_live = jnp.concatenate([r_ok, r_ok, w_ok, w_ok])
-    ranks, conv = _bucketed_lower_bound(ks, bucket_idx, count, queries, search_iters)
-    converged = ~jnp.any(q_live & ~conv)
-    g_lo = ranks[:R] - 1          # gap containing rb (ks[0]="" <= any key)
-    g_hi = ranks[R : 2 * R]       # first boundary >= re
-    wb_rank = ranks[2 * R : 2 * R + Wn]
-    we_rank = ranks[2 * R + Wn :]
+    g_lo, g_hi, wb_rank, we_rank, converged = phase_search(
+        ks, bucket_idx, count, rb, re_, wb, we, r_ok, w_ok, search_iters
+    )
 
     # ---- phase 1: history conflicts ------------------------------------
-    hist_table = build_sparse_table(vs, jnp.maximum, 0)
-    read_max = query_sparse_table(hist_table, g_lo, g_hi, jnp.maximum, 0)
-    r_hist = r_ok & (read_max > snap[r_idx])
-    hist = jnp.zeros(B, jnp.int32).at[r_idx].add(r_hist.astype(jnp.int32)) > 0
+    hist = phase_history(vs, g_lo, g_hi, snap, r_idx, r_ok, B)
 
     # ---- phase 2: intra-batch conflicts (dense, rank space) -------------
-    # Later txns must see earlier *committed* txns' writes (the reference's
-    # ordered MiniConflictSet walk, SkipList.cpp:1133-1152).  Solved as a
-    # fixpoint over a dense [R, Wn] overlap predicate evaluated in local
-    # rank space — recomputed inside the reduce each iteration, so nothing
-    # R×Wn is ever materialized in HBM.
-    lr = _local_ranks(jnp.concatenate([rb, re_, wb, we], axis=0))
-    rb_r, re_r = lr[:R], lr[R : 2 * R]
-    wb_r, we_r = lr[2 * R : 2 * R + Wn], lr[2 * R + Wn :]
-    tx_iota = jnp.arange(B, dtype=jnp.int32)
-
-    def _body(state):
-        intra, _, it = state
-        committed = active & ~hist & ~intra
-        w_com = w_ok & committed[w_idx]
-        w_cand = jnp.where(w_com, w_tx, I32_MAX)  # [Wn]
-        ov = (wb_r[None, :] < re_r[:, None]) & (rb_r[:, None] < we_r[None, :])
-        minw = jnp.min(
-            jnp.where(ov, w_cand[None, :], I32_MAX), axis=1
-        )  # earliest committed writer overlapping each read
-        minw = jnp.where(r_ok, minw, I32_MAX)
-        tx_minw = jnp.full(B, I32_MAX, jnp.int32).at[r_idx].min(minw)
-        new_intra = tx_minw < tx_iota  # strictly-earlier committed writer
-        changed = jnp.any(new_intra != intra)
-        return new_intra, changed, it + 1
-
-    def _cond(state):
-        _, changed, it = state
-        return changed & (it < B + 2)
-
-    intra, _, _ = jax.lax.while_loop(
-        _cond, _body, (jnp.zeros(B, bool), jnp.asarray(True), jnp.int32(0))
+    intra, _n_iters = phase_intra(
+        rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active, hist, B
     )
 
     committed = active & ~hist & ~intra
@@ -229,9 +256,29 @@ def resolve_core(
     )
 
     # ---- phase 3: merge committed writes into the step function ---------
+    w_ins = w_ok & committed[w_idx]
+    new_ks, new_vs, new_count, new_bucket_idx = phase_merge(
+        ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, cap=cap
+    )
+
+    # validity of THIS batch folded into the stream's accumulator INSIDE the
+    # kernel: pipelined callers fetch one scalar per drain instead of paying
+    # a host link round trip (or a separate tiny program) per batch
+    ok = ok_in & converged & (new_count <= cap)
+    return verdict, new_ks, new_vs, new_count, new_bucket_idx, converged, ok
+
+
+def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
+    """Insert committed writes into the step function (replaces
+    mergeWriteConflictRanges :1260): canonicalize the committed writes'
+    union on the write-endpoint slot domain (scatter deltas + cumsum),
+    merge the canonical boundaries into the state by merge-path scatter
+    positions derived from the ONE search's ranks, recompute gap values
+    with a coverage cumsum on the merged domain, and coalesce equal-valued
+    neighbours.  Returns (new_ks, new_vs, new_count, new_bucket_idx)."""
+    Wn, W = wb.shape
     # 3a. canonical committed-write union on the write-endpoint slot domain
     # (slots = unique write endpoint keys, in key order).
-    w_ins = w_ok & committed[w_idx]
     wlr = _local_ranks(jnp.concatenate([wb, we], axis=0))  # [2Wn] slot ids
     s_b, s_e = wlr[:Wn], wlr[Wn:]
     nslots = 2 * Wn
@@ -310,12 +357,7 @@ def resolve_core(
     h_all = (new_ks[:, 0] >> BUCKET_BITS).astype(jnp.int32)
     hist_b = jnp.zeros(N_BUCKETS + 1, jnp.int32).at[h_all + 1].add(1)
     new_bucket_idx = jnp.cumsum(hist_b)
-
-    # validity of THIS batch folded into the stream's accumulator INSIDE the
-    # kernel: pipelined callers fetch one scalar per drain instead of paying
-    # a host link round trip (or a separate tiny program) per batch
-    ok = ok_in & converged & (new_count <= cap)
-    return verdict, new_ks, new_vs, new_count, new_bucket_idx, converged, ok
+    return new_ks, new_vs, new_count, new_bucket_idx
 
 
 _resolve_kernel = functools.partial(

@@ -1,0 +1,144 @@
+"""Per-phase accounting for the device conflict kernel on real hardware —
+the analog of skipListTest's sort/combine/checkRead/merge PerfCounters
+(fdbserver/SkipList.cpp:1412-1502).
+
+Runs CUMULATIVE truncations of resolve_core (search | +history | +intra |
+full) at bench.py shapes on a prefilled state; each truncation returns one
+scalar digest so tunnel transfer cost never pollutes the timing (the axon
+tunnel moves whole arrays at ~45 MB/s; block_until_ready does not block).
+Phase cost = difference between successive truncations.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import bench as B
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.conflict import device as D
+
+    print(f"backend: {jax.default_backend()}", flush=True)
+
+    rng = np.random.default_rng(B.SEED)
+    pool = B.gen_pool(rng)
+    pool_words = B.pool_to_words(pool)
+    versions = iter(range(1, 10_000))
+    prefill = [B.gen_batch(rng, pool, next(versions)) for _ in range(B.PREFILL_BATCHES)]
+    timed = [B.gen_batch(rng, pool, next(versions)) for _ in range(4)]
+
+    dev = D.DeviceConflictSet(max_key_bytes=B.MAX_KEY_BYTES, capacity=B.CAP)
+    t0 = time.perf_counter()
+    for b in prefill:
+        dev.resolve_arrays(b["version"], *B.device_pack(pool_words, b, B._bucket))
+    print(
+        f"prefill {time.perf_counter() - t0:.1f}s, live boundaries {dev.boundary_count}",
+        flush=True,
+    )
+
+    args0 = B.device_pack(pool_words, timed[0], B._bucket)
+    rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p = [jnp.asarray(a) for a in args0]
+    Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
+    commit_off = jnp.int32(dev._offset(timed[0]["version"]))
+    cap = dev._cap
+
+    def common(ks, vs, bidx, count):
+        r_ok = rtv >= 0
+        r_idx = jnp.clip(rtv, 0, Bp - 1)
+        w_ok = (wtv >= 0) & ~D._is_sentinel(wbv)
+        w_idx = jnp.clip(wtv, 0, Bp - 1)
+        return r_ok, r_idx, w_ok, w_idx
+
+    @jax.jit
+    def t_search(ks, vs, bidx, count):
+        r_ok, r_idx, w_ok, w_idx = common(ks, vs, bidx, count)
+        g_lo, g_hi, wbr, wer, conv = D.phase_search(
+            ks, bidx, count, rbv, rev, wbv, wev, r_ok, w_ok, D.FAST_SEARCH_ITERS
+        )
+        return g_lo.sum() + g_hi.sum() + wbr.sum() + wer.sum()
+
+    @jax.jit
+    def t_hist(ks, vs, bidx, count):
+        r_ok, r_idx, w_ok, w_idx = common(ks, vs, bidx, count)
+        g_lo, g_hi, wbr, wer, conv = D.phase_search(
+            ks, bidx, count, rbv, rev, wbv, wev, r_ok, w_ok, D.FAST_SEARCH_ITERS
+        )
+        hist = D.phase_history(vs, g_lo, g_hi, snap_p, r_idx, r_ok, Bp)
+        return g_lo.sum() + hist.sum()
+
+    @jax.jit
+    def t_intra(ks, vs, bidx, count):
+        r_ok, r_idx, w_ok, w_idx = common(ks, vs, bidx, count)
+        g_lo, g_hi, wbr, wer, conv = D.phase_search(
+            ks, bidx, count, rbv, rev, wbv, wev, r_ok, w_ok, D.FAST_SEARCH_ITERS
+        )
+        hist = D.phase_history(vs, g_lo, g_hi, snap_p, r_idx, r_ok, Bp)
+        intra, n_iters = D.phase_intra(
+            rbv, rev, wbv, wev, r_ok, w_ok, r_idx, w_idx, wtv, active_p,
+            hist, Bp,
+        )
+        return g_lo.sum() + hist.sum() + intra.sum(), n_iters
+
+    full = functools.partial(
+        jax.jit, static_argnames=("cap", "n_txn", "n_read", "n_write", "search_iters")
+    )(D.resolve_core)
+
+    @jax.jit
+    def t_full(ks, vs, bidx, count):
+        verdict, nks, nvs, ncount, nbidx, conv, ok = full(
+            ks, vs, bidx, count, rbv, rev, rtv, wbv, wev, wtv,
+            snap_p, active_p, commit_off,
+            cap=cap, n_txn=Bp, n_read=R, n_write=Wn,
+        )
+        return verdict.sum() + ncount + nbidx[0]
+
+    st = (dev._ks, dev._vs, dev._bidx, dev._dev_count)
+
+    def fetch(o):
+        return np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[:1]
+
+    # RTT floor
+    g = jax.jit(lambda v: v + 1)
+    fetch(g(jnp.ones((8,), jnp.int32)))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fetch(g(jnp.ones((8,), jnp.int32)))
+        ts.append(time.perf_counter() - t0)
+    rtt = sorted(ts)[2] * 1e3
+    print(f"RTT floor {rtt:.1f} ms", flush=True)
+
+    results = {}
+    for name, fn in (("search", t_search), ("search+hist", t_hist),
+                     ("search+hist+intra", t_intra), ("FULL kernel", t_full)):
+        fetch(fn(*st))  # compile
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = fn(*st)
+            fetch(out)
+            ts.append(time.perf_counter() - t0)
+        ms = sorted(ts)[2] * 1e3 - rtt
+        results[name] = ms
+        extra = ""
+        if name == "search+hist+intra":
+            extra = f"  (fixpoint iters: {int(np.asarray(out[1]))})"
+        print(f"  {name:<22s} {ms:9.1f} ms{extra}", flush=True)
+
+    s = results
+    print("\nphase deltas:", flush=True)
+    print(f"  search          {s['search']:9.1f} ms")
+    print(f"  history (RMQ)   {s['search+hist'] - s['search']:9.1f} ms")
+    print(f"  intra fixpoint  {s['search+hist+intra'] - s['search+hist']:9.1f} ms")
+    print(f"  merge+buckets   {s['FULL kernel'] - s['search+hist+intra']:9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

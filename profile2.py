@@ -1,0 +1,155 @@
+"""Scaling-law probes: how do gather/scatter/sort/table-build costs scale
+with index count and output size on this TPU? Decides the kernel redesign."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from profile_kernel import _RTT_MS, _force, bench_one
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend: {jax.default_backend()}")
+    one = jnp.ones((8,), jnp.int32)
+    trivial = jax.jit(lambda x: x + 1)
+    _force(trivial(one))
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        _force(trivial(one))
+        ts.append(time.perf_counter() - t0)
+    _RTT_MS[0] = sorted(ts)[len(ts) // 2] * 1e3
+    print(f"RTT floor {_RTT_MS[0]:.2f} ms")
+
+    rng = np.random.default_rng(3)
+    CAP = 1 << 19
+    W = 5
+    table = jnp.asarray(rng.integers(0, 2**32, size=(CAP, W), dtype=np.uint64).astype(np.uint32))
+    vals = jnp.asarray(rng.integers(0, 1 << 20, size=(CAP,), dtype=np.int64).astype(np.int32))
+
+    # --- gather scaling: k indices from CAP rows ---
+    for k in (1 << 14, 1 << 16, 1 << 17, 1 << 18, 1 << 19):
+        idx = jnp.asarray(rng.integers(0, CAP, size=(k,), dtype=np.int64).astype(np.int32))
+        bench_one(f"gather rows k={k:>7}", lambda t, i: jnp.take(t, i, axis=0), table, idx)
+    # scalar gather
+    for k in (1 << 16, 1 << 19):
+        idx = jnp.asarray(rng.integers(0, CAP, size=(k,), dtype=np.int64).astype(np.int32))
+        bench_one(f"gather scalars k={k:>7}", lambda t, i: jnp.take(t, i), vals, idx)
+
+    # --- scatter scaling: k updates into m-sized output ---
+    for m in (1 << 16, 1 << 18, 1 << 19):
+        for k in (1 << 14, 1 << 16, 1 << 18):
+            if k > m:
+                continue
+            idx = jnp.asarray(rng.choice(m, size=k, replace=False).astype(np.int32))
+            v = jnp.asarray(rng.integers(0, 100, size=(k,), dtype=np.int64).astype(np.int32))
+            bench_one(
+                f"scat-set scalars k={k:>7} m={m:>7}",
+                lambda i, v, m=m: jnp.zeros(m, jnp.int32).at[i].set(v),
+                idx, v,
+            )
+    # drop-mode and row variants at one size
+    m, k = 1 << 19, 1 << 16
+    idx = jnp.asarray(rng.choice(m, size=k, replace=False).astype(np.int32))
+    v = jnp.asarray(rng.integers(0, 100, size=(k,), dtype=np.int64).astype(np.int32))
+    rows = jnp.asarray(rng.integers(0, 2**32, size=(k, W), dtype=np.uint64).astype(np.uint32))
+    bench_one("scat-set scalars drop-mode", lambda i, v: jnp.zeros(m, jnp.int32).at[i].set(v, mode="drop"), idx, v)
+    bench_one("scat-set scalars sorted idx", lambda i, v: jnp.zeros(m, jnp.int32).at[i].set(v), jnp.sort(idx), v)
+    bench_one(
+        "scat-set scalars sorted+hints",
+        lambda i, v: jnp.zeros(m, jnp.int32).at[i].set(v, indices_are_sorted=True, unique_indices=True),
+        jnp.sort(idx), v,
+    )
+    bench_one("scat-set rows k=65K m=524K", lambda i, r: jnp.zeros((m, W), jnp.uint32).at[i].set(r), idx, rows)
+    bench_one("scat-add scalars k=65K m=524K", lambda i, v: jnp.zeros(m, jnp.int32).at[i].add(v), idx, v)
+
+    # --- one-hot matmul alternative for scatter-add (MXU!) ---
+    # segment-sum via sort+cumsum alternative
+    def sort_cumsum_hist(i):
+        si = jnp.sort(i)
+        edges = jnp.arange(m + 1, dtype=jnp.int32)
+        pos = jnp.searchsorted(si, edges)
+        return jnp.diff(pos)
+
+    bench_one("hist via sort+searchsorted k=65K m=524K", sort_cumsum_hist, idx)
+
+    # --- sort scaling ---
+    for k in (1 << 16, 1 << 18, (1 << 19) + (1 << 14)):
+        x = jnp.asarray(rng.integers(0, 2**31, size=(k,), dtype=np.int64).astype(np.int32))
+        p = jnp.asarray(np.arange(k, dtype=np.int32))
+        bench_one(f"sort i32+payload k={k:>7}", lambda a, b: jax.lax.sort((a, b), num_keys=1), x, p)
+    # multi-word sort: 2 key words + 2 payloads
+    k = 1 << 19
+    x0 = jnp.asarray(rng.integers(0, 2**31, size=(k,), dtype=np.int64).astype(np.int32))
+    x1 = jnp.asarray(rng.integers(0, 2**31, size=(k,), dtype=np.int64).astype(np.int32))
+    p = jnp.asarray(np.arange(k, dtype=np.int32))
+    bench_one("sort 2-key+1payload k=524K", lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2), x0, x1, p)
+
+    # --- sparse table build variants ---
+    from foundationdb_tpu.ops.rmq import build_sparse_table
+    bench_one("table build (current, stack of L)", lambda v: build_sparse_table(v, jnp.maximum, 0), vals)
+
+    def build_flat(v):
+        # same recurrence but keep only a rolling pair, output concatenated
+        n = v.shape[0]
+        out = [v]
+        prev = v
+        for l in range(1, 20):
+            s = 1 << (l - 1)
+            shifted = jnp.concatenate([prev[s:], jnp.zeros((s,), prev.dtype)])
+            prev = jnp.maximum(prev, shifted)
+            out.append(prev)
+        return jnp.concatenate(out)
+
+    bench_one("table build (concat out)", build_flat, vals)
+
+    def build_2d(v):
+        n = v.shape[0]
+
+        def body(l, t):
+            s = jnp.int32(1) << (l - 1)
+            prev = t[l - 1]
+            shifted = jnp.where(
+                jnp.arange(n) + s < n,
+                jnp.roll(prev, -s).astype(prev.dtype),
+                jnp.zeros((), prev.dtype),
+            )
+            return t.at[l].set(jnp.maximum(prev, shifted))
+
+        t0 = jnp.zeros((20, n), v.dtype).at[0].set(v)
+        return jax.lax.fori_loop(1, 20, body, t0)
+
+    bench_one("table build (fori dyn-update)", build_2d, vals)
+
+    # padded-pow2 disjoint-block pyramid (each level half size, total 2N)
+    def build_pyramid(v):
+        n = v.shape[0]
+        out = [v]
+        prev = v
+        while prev.shape[0] > 1:
+            h = prev.shape[0] // 2
+            prev = jnp.maximum(prev[0 : 2 * h : 2], prev[1 : 2 * h : 2])
+            out.append(prev)
+        return out
+
+    bench_one("disjoint pyramid build (total 2N)", build_pyramid, vals)
+
+    # --- concat / slice / elementwise sanity ---
+    bench_one("elementwise max CAP x20", lambda v: sum(jnp.maximum(v, v + i) for i in range(20)), vals)
+    bench_one(
+        "concat shift + max, one level",
+        lambda v: jnp.maximum(v, jnp.concatenate([v[256:], jnp.zeros((256,), v.dtype)])),
+        vals,
+    )
+    bench_one("cumsum CAP", lambda v: jnp.cumsum(v), vals)
+    bench_one("searchsorted 49K into CAP", lambda v, q: jnp.searchsorted(v, q),
+              jnp.sort(vals), jnp.asarray(rng.integers(0, 1 << 20, size=(49152,), dtype=np.int64).astype(np.int32)))
+
+
+if __name__ == "__main__":
+    main()

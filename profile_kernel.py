@@ -1,0 +1,225 @@
+"""Per-phase device microbenchmarks for the conflict kernel.
+
+Times each primitive of conflict/device.py's resolve_core at bench.py's
+shapes (CAP=2^19, R=16K, Wn=8K, W=5) so optimization attacks the measured
+dominator, mirroring skipListTest's per-phase PerfCounters
+(fdbserver/SkipList.cpp:1412-1502).
+
+Usage:  python profile_kernel.py            # real device (axon TPU)
+        JAX_PLATFORMS=cpu python profile_kernel.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+_RTT_MS = [0.0]  # measured host<->device round-trip floor, subtracted
+
+
+def _force(out):
+    """Flatten outputs and fetch one element of each to host — the only
+    reliable completion barrier over the axon tunnel (block_until_ready
+    returns at dispatch-accept, not execution-done)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(out)
+    return [np.asarray(l).ravel()[:1] for l in leaves]
+
+
+def bench_one(name, fn, *args, n=5):
+    import jax
+
+    fn = jax.jit(fn)
+    _force(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ms = sorted(ts)[len(ts) // 2] * 1e3 - _RTT_MS[0]
+    print(f"  {name:<42s} {ms:9.2f} ms")
+    return ms
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.ops.rmq import build_sparse_table, query_sparse_table
+
+    print(f"backend: {jax.default_backend()}  devices: {jax.devices()}")
+
+    # round-trip floor: time a trivial fetch, subtract from every sample
+    one = jnp.ones((8,), jnp.int32)
+    trivial = jax.jit(lambda x: x + 1)
+    _force(trivial(one))
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        _force(trivial(one))
+        ts.append(time.perf_counter() - t0)
+    _RTT_MS[0] = sorted(ts)[len(ts) // 2] * 1e3
+    print(f"host<->device round-trip floor: {_RTT_MS[0]:.2f} ms (subtracted)")
+
+    CAP = 1 << 19
+    W = 5
+    R, Wn = 16384, 8192
+    B = 8192
+    M = CAP + 2 * Wn
+    rng = np.random.default_rng(7)
+
+    ks = jnp.asarray(
+        np.sort(rng.integers(0, 2**32, size=(CAP,), dtype=np.uint64)).astype(np.uint32)
+    )
+    ks_rows = jnp.asarray(rng.integers(0, 2**32, size=(CAP, W), dtype=np.uint64).astype(np.uint32))
+    vs = jnp.asarray(rng.integers(0, 1 << 20, size=(CAP,), dtype=np.int64).astype(np.int32))
+    q_rows = jnp.asarray(rng.integers(0, 2**32, size=(2 * R + 2 * Wn, W), dtype=np.uint64).astype(np.uint32))
+    bidx = jnp.asarray(np.arange(0, 65537, dtype=np.int32) * (CAP // 65536))
+
+    scat_idx = jnp.asarray(np.sort(rng.choice(M, size=2 * Wn, replace=False)).astype(np.int32))
+    scat_rows = jnp.asarray(rng.integers(0, 2**32, size=(2 * Wn, W), dtype=np.uint64).astype(np.uint32))
+    pos_old = jnp.asarray((np.arange(CAP) + np.linspace(0, 2 * Wn, CAP).astype(np.int64)).astype(np.int32))
+    gidx = jnp.asarray(rng.integers(0, CAP, size=(M,), dtype=np.int64).astype(np.int32))
+
+    print(f"shapes: CAP={CAP} R={R} Wn={Wn} M={M} W={W}")
+
+    # --- search primitives ---
+    from foundationdb_tpu.conflict.device import _bucketed_lower_bound
+    bench_one(
+        "search: bucketed_lower_bound 49K q, 11 it",
+        lambda k, bi, q: _bucketed_lower_bound(k, bi, jnp.int32(CAP), q, 11)[0],
+        ks_rows, bidx, q_rows,
+    )
+
+    # --- phase 1 ---
+    g_lo = jnp.asarray(rng.integers(0, CAP - 1, size=(R,), dtype=np.int64).astype(np.int32))
+    g_hi = jnp.minimum(g_lo + jnp.asarray(rng.integers(1, 3, size=(R,), dtype=np.int64).astype(np.int32)), CAP - 1)
+    bench_one("p1: build_sparse_table over CAP", lambda v: build_sparse_table(v, jnp.maximum, 0), vs)
+    tbl = jax.jit(lambda v: build_sparse_table(v, jnp.maximum, 0))(vs)
+    bench_one(
+        "p1: query_sparse_table 16K ranges",
+        lambda t, lo, hi: query_sparse_table(t, lo, hi, jnp.maximum, 0),
+        tbl, g_lo, g_hi,
+    )
+
+    # --- phase 2 (one fixpoint iteration) ---
+    rb_r = jnp.asarray(rng.integers(0, 2 * (R + Wn), size=(R,), dtype=np.int64).astype(np.int32))
+    re_r = rb_r + 1
+    wb_r = jnp.asarray(rng.integers(0, 2 * (R + Wn), size=(Wn,), dtype=np.int64).astype(np.int32))
+    we_r = wb_r + 1
+    w_cand = jnp.asarray(rng.integers(0, B, size=(Wn,), dtype=np.int64).astype(np.int32))
+
+    def p2_iter(rb_r, re_r, wb_r, we_r, w_cand):
+        ov = (wb_r[None, :] < re_r[:, None]) & (rb_r[:, None] < we_r[None, :])
+        return jnp.min(jnp.where(ov, w_cand[None, :], 2**31 - 1), axis=1)
+
+    bench_one("p2: one R x Wn masked-min iteration", p2_iter, rb_r, re_r, wb_r, we_r, w_cand)
+
+    # --- phase 3 primitives ---
+    bench_one(
+        "p3: row scatter 16K rows into M",
+        lambda idx, rows: jnp.full((M, W), 0xFFFFFFFF, jnp.uint32).at[idx].set(rows, mode="drop"),
+        scat_idx, scat_rows,
+    )
+    bench_one(
+        "p3: row scatter CAP rows into M (pos_old)",
+        lambda idx, rows: jnp.full((M, W), 0xFFFFFFFF, jnp.uint32).at[idx].set(rows, mode="drop"),
+        pos_old, ks_rows,
+    )
+    bench_one(
+        "p3: BOTH merge scatters (old+new)",
+        lambda po, kr, pn, ur: jnp.full((M, W), 0xFFFFFFFF, jnp.uint32)
+        .at[po].set(kr, mode="drop").at[pn].set(ur, mode="drop"),
+        pos_old, ks_rows, scat_idx, scat_rows,
+    )
+    bench_one(
+        "p3: scalar scatter-add 16K into M",
+        lambda idx: jnp.zeros(M, jnp.int32).at[idx].add(1, mode="drop"),
+        scat_idx,
+    )
+    bench_one(
+        "p3: scalar scatter CAP vals into M",
+        lambda idx, v: jnp.zeros(M, jnp.int32).at[idx].set(v, mode="drop"),
+        pos_old, vs,
+    )
+    bench_one("p3: cumsum over M", lambda x: jnp.cumsum(x), jnp.zeros(M, jnp.int32))
+    bench_one(
+        "p3: gather M rows from CAP",
+        lambda k, i: jnp.take(k, i, axis=0),
+        ks_rows, gidx,
+    )
+    keep = jnp.asarray(rng.random(M) < 0.5)
+    mrows = jnp.asarray(rng.integers(0, 2**32, size=(M, W), dtype=np.uint64).astype(np.uint32))
+
+    def compact_scatter(keep, rows):
+        pos = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, M)
+        return jnp.full((CAP, W), 0xFFFFFFFF, jnp.uint32).at[pos].set(rows, mode="drop")
+
+    bench_one("p3: compaction scatter M rows -> CAP", compact_scatter, keep, mrows)
+
+    # sort alternatives
+    bench_one(
+        "alt: argsort 16K int32",
+        lambda x: jnp.argsort(x),
+        jnp.asarray(rng.integers(0, M, size=(2 * Wn,), dtype=np.int64).astype(np.int32)),
+    )
+    bench_one(
+        "alt: lexsort M rows (W keys)",
+        lambda r: jnp.lexsort(tuple(r[:, w] for w in range(W - 1, -1, -1))),
+        mrows,
+    )
+    bench_one(
+        "alt: sort M int32 + payload",
+        lambda k, p: jax.lax.sort((k, p), num_keys=1),
+        jnp.asarray(rng.integers(0, 2**31, size=(M,), dtype=np.int64).astype(np.int32)),
+        jnp.asarray(np.arange(M, dtype=np.int32)),
+    )
+
+    # --- bucket rebuild ---
+    h_all = (ks_rows[:, 0] >> 16).astype(jnp.int32)
+    bench_one(
+        "bucket: histogram scatter-add CAP -> 65K + cumsum",
+        lambda h: jnp.cumsum(jnp.zeros(65537, jnp.int32).at[h + 1].add(1)),
+        h_all,
+    )
+
+    # --- whole kernel at bench shapes for reference ---
+    from foundationdb_tpu.conflict.device import resolve_core
+    import functools
+
+    kern = functools.partial(
+        jax.jit, static_argnames=("cap", "n_txn", "n_read", "n_write", "search_iters")
+    )(resolve_core)
+    rb = q_rows[:R]
+    re_ = q_rows[R : 2 * R]
+    wb = q_rows[2 * R : 2 * R + Wn]
+    we = q_rows[2 * R + Wn :]
+    r_tx = jnp.asarray(np.repeat(np.arange(B, dtype=np.int32), 2))
+    w_tx = jnp.asarray(np.arange(B, dtype=np.int32))
+    snap = jnp.zeros(B, jnp.int32)
+    active = jnp.ones(B, bool)
+
+    def whole(ks_rows, vs, bidx, rb, re_, wb, we):
+        return kern(
+            ks_rows, vs, bidx, jnp.int32(CAP // 2), rb, re_, r_tx, wb, we, w_tx,
+            snap, active, jnp.int32(1 << 20),
+            cap=CAP, n_txn=B, n_read=R, n_write=Wn,
+        )
+
+    _force(whole(ks_rows, vs, bidx, rb, re_, wb, we))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _force(whole(ks_rows, vs, bidx, rb, re_, wb, we))
+        ts.append(time.perf_counter() - t0)
+    print(
+        f"  {'WHOLE resolve_core kernel':<42s} "
+        f"{sorted(ts)[1] * 1e3 - _RTT_MS[0]:9.2f} ms"
+    )
+
+
+if __name__ == "__main__":
+    main()
