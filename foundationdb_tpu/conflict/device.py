@@ -83,6 +83,16 @@ N_BUCKETS = 1 << BUCKET_BITS
 FAST_SEARCH_ITERS = 11  # converges windows up to 1024 boundaries (2**(n-1))
 
 
+def host_bucket_index(ks_rows: np.ndarray) -> np.ndarray:
+    """word0-prefix bucket index of sorted boundary rows, host-side (the np
+    twin of phase_merge step 3d; sentinels land in the last bucket).  Single
+    source of truth for every host construction site."""
+    h = (np.asarray(ks_rows)[:, 0] >> BUCKET_BITS).astype(np.int64)
+    return np.cumsum(np.bincount(h + 1, minlength=N_BUCKETS + 1))[
+        : N_BUCKETS + 1
+    ].astype(np.int32)
+
+
 def _local_ranks(rows: jnp.ndarray) -> jnp.ndarray:
     """Dense order ranks of uint32[N, W] rows: equal rows share a rank and
     strict rank order == strict lexicographic order.  One sort + cumsum —
@@ -469,12 +479,16 @@ class DeviceConflictSet(ConflictSet):
         self._count = count
         self._count_ub = count
         self._dev_count = jnp.int32(count)
-        self._dev_ok = jnp.asarray(True)
-        self._pipelined_since_check = 0
-        h = (nks[:, 0] >> BUCKET_BITS).astype(np.int64)
-        self._bidx = jnp.asarray(
-            np.cumsum(np.bincount(h + 1, minlength=N_BUCKETS + 1)).astype(np.int32)
-        )
+        if not hasattr(self, "_dev_ok"):
+            # fresh construction only: a capacity regrow must NOT reset the
+            # pipelined-stream validity accumulator (a pending deferred
+            # failure would be silently forgotten and wrong verdicts trusted)
+            self._dev_ok = jnp.asarray(True)
+            self._pipelined_since_check = 0
+        # diagnostics: how often the fast bucketed search failed to converge
+        # (adversarial shared-prefix keys) and the full-depth replay ran
+        self.search_fallbacks = getattr(self, "search_fallbacks", 0)
+        self._bidx = jnp.asarray(host_bucket_index(nks))
 
     @property
     def oldest_version(self) -> int:
@@ -579,7 +593,7 @@ class DeviceConflictSet(ConflictSet):
 
         while True:
             pre_ks, pre_vs, pre_dev_count = self._ks, self._vs, self._dev_count
-            iters = FAST_SEARCH_ITERS
+            iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
             while True:
                 verdict, new_ks, new_vs, new_count, new_bidx, conv, _ok = _resolve_kernel(
                     self._ks, self._vs, self._bidx, self._dev_count,
@@ -593,6 +607,7 @@ class DeviceConflictSet(ConflictSet):
                 # a word0-prefix bucket was deeper than 2**iters (adversarial
                 # shared-prefix keys): replay at full search depth — the
                 # kernel is pure, so the replay is exact
+                self.search_fallbacks += 1
                 iters = _levels(self._cap) + 1
             new_count_i = int(new_count)
             if new_count_i <= self._cap:

@@ -39,7 +39,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import keys as keymod
 from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
-from ..conflict.device import _SENT_WORD, N_BUCKETS, pack_batch, resolve_core
+from ..conflict.device import (
+    _SENT_WORD,
+    FAST_SEARCH_ITERS,
+    host_bucket_index,
+    pack_batch,
+    resolve_core,
+)
 from ..ops.rmq import _levels
 from ..ops.search import lex_less
 
@@ -79,26 +85,28 @@ def _clip_ranges(b, e, tx, lo_row, hi_row):
 
 
 def _sharded_resolve(
-    ks, vs, cnt,  # per-device state shards: [1, CAP, W], [1, CAP], [1]
+    ks, vs, cnt, bidx,  # per-device state shards: [1, CAP, W], [1, CAP], [1], [1, NB+1]
     lo, hi,  # per-device partition bounds: [1, W] each
     rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,  # replicated batch
-    *, cap, n_txn, n_read, n_write,
+    ok_in,  # replicated bool: validity accumulated across a pipelined stream
+    *, cap, n_txn, n_read, n_write, search_iters,
 ):
-    ks, vs, lo, hi = ks[0], vs[0], lo[0], hi[0]
+    ks, vs, lo, hi, bidx = ks[0], vs[0], lo[0], hi[0], bidx[0]
     rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
     wb, we, w_tx = _clip_ranges(wb, we, w_tx, lo, hi)
-    # full-depth search (bucket index unused at full depth): partition caps
-    # are small, and it keeps the sharded path free of fallback control flow
-    dummy_bidx = jnp.zeros(N_BUCKETS + 1, jnp.int32)
-    verdict, new_ks, new_vs, new_count, _bidx, _conv, _ok = resolve_core(
-        ks, vs, dummy_bidx, cnt[0], rb, re_, r_tx, wb, we, w_tx, snap, active,
-        commit_off,
+    verdict, new_ks, new_vs, new_count, new_bidx, conv, ok = resolve_core(
+        ks, vs, bidx, cnt[0], rb, re_, r_tx, wb, we, w_tx, snap, active,
+        commit_off, ok_in,
         cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write,
-        search_iters=_levels(cap) + 1,
+        search_iters=search_iters,
     )
-    # proxy min-combine (MasterProxyServer.actor.cpp:558-569) over ICI
+    # proxy min-combine (MasterProxyServer.actor.cpp:558-569) over ICI; the
+    # convergence / stream-validity flags fold the same way (all devices must
+    # agree before a batch's verdicts are trusted)
     merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
-    return merged, new_ks[None], new_vs[None], new_count[None]
+    all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
+    all_ok = jax.lax.pmin(ok.astype(jnp.int32), RESOLVER_AXIS) > 0
+    return merged, new_ks[None], new_vs[None], new_count[None], new_bidx[None], all_conv, all_ok
 
 
 @jax.jit
@@ -109,17 +117,20 @@ def _sharded_gc(vs, off):
     return jnp.maximum(vs - off, 0)
 
 
-def build_sharded_resolver(mesh: Mesh, *, cap: int, n_txn: int, n_read: int, n_write: int):
+def build_sharded_resolver(
+    mesh: Mesh, *, cap: int, n_txn: int, n_read: int, n_write: int, search_iters: int
+):
     """Jit-compiled sharded resolve step for fixed bucket sizes."""
     shard = P(RESOLVER_AXIS)
     repl = P()
     fn = jax.shard_map(
         functools.partial(
-            _sharded_resolve, cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write
+            _sharded_resolve, cap=cap, n_txn=n_txn, n_read=n_read,
+            n_write=n_write, search_iters=search_iters,
         ),
         mesh=mesh,
-        in_specs=(shard, shard, shard, shard, shard) + (repl,) * 9,
-        out_specs=(repl, shard, shard, shard),
+        in_specs=(shard, shard, shard, shard, shard, shard) + (repl,) * 10,
+        out_specs=(repl, shard, shard, shard, shard, repl, repl),
         # the kernel's loop carries start replicated and become varying;
         # skip the static replication check rather than pcast every carry
         check_vma=False,
@@ -154,28 +165,54 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._mesh = mesh
         self._n = n
         self._max_key_bytes = max_key_bytes
-        self._W = W = keymod.num_words(max_key_bytes)
-        self._cap = capacity
+        self._W = keymod.num_words(max_key_bytes)
         self._base = oldest_version
         self._oldest = oldest_version
         self._last_commit = oldest_version
-        self._fns: dict[tuple[int, int, int], object] = {}
+        self._fns: dict[tuple[int, int, int, int, int], object] = {}
+        self.search_fallbacks = 0
+        self.regrows = 0
 
         bounds = [b""] + list(split_keys)
         lo = keymod.encode_keys(bounds, max_key_bytes)
         hi = np.empty_like(lo)
         hi[:-1] = lo[1:]
         hi[-1] = keymod.sentinel(max_key_bytes)
-        ks = np.full((n, capacity, W), _SENT_WORD, dtype=np.uint32)
-        ks[:, 0, :] = lo  # each partition's step function starts at its own floor
-        vs = np.zeros((n, capacity), dtype=np.int32)
-
         self._state_sharding = NamedSharding(mesh, P(RESOLVER_AXIS))
         dev = functools.partial(jax.device_put, device=self._state_sharding)
         self._lo, self._hi = dev(lo), dev(hi)
-        self._ks, self._vs = dev(ks), dev(vs)
-        self._counts = np.ones(n, dtype=np.int64)
-        self._dev_counts = dev(np.ones(n, dtype=np.int32))
+        self._np_lo = lo
+        self._init_state(capacity)
+
+    def _init_state(self, capacity: int, ks=None, vs=None, counts=None) -> None:
+        """Fresh (or regrown) per-partition state arrays."""
+        n, W = self._n, self._W
+        nks = np.full((n, capacity, W), _SENT_WORD, dtype=np.uint32)
+        nvs = np.zeros((n, capacity), dtype=np.int32)
+        if ks is None:
+            nks[:, 0, :] = self._np_lo  # each partition starts at its own floor
+            counts = np.ones(n, dtype=np.int64)
+        else:
+            c = min(ks.shape[1], capacity)
+            nks[:, :c] = np.asarray(ks)[:, :c]
+            nvs[:, :c] = np.asarray(vs)[:, :c]
+        self._cap = capacity
+        self._fns = {}  # cap is a static arg of the compiled step
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        self._ks, self._vs = dev(nks), dev(nvs)
+        self._counts = np.asarray(counts, dtype=np.int64)
+        self._counts_ub = self._counts.copy()
+        self._dev_counts = dev(self._counts.astype(np.int32))
+        if not hasattr(self, "_dev_ok"):
+            # fresh construction only: a regrow must not reset the pipelined
+            # validity accumulator (same contract as DeviceConflictSet)
+            self._dev_ok = jax.device_put(
+                np.asarray(True), NamedSharding(self._mesh, P())
+            )
+            self._pipelined_since_check = 0
+        # word0-prefix bucket index per partition (sentinels -> last bucket)
+        bidx = np.stack([host_bucket_index(nks[i]) for i in range(n)])
+        self._bidx = dev(bidx)
 
     @property
     def oldest_version(self) -> int:
@@ -187,46 +224,141 @@ class ShardedDeviceConflictSet(ConflictSet):
             raise OverflowError("version offset overflow; call remove_before")
         return max(off, 0)
 
-    def _fn(self, n_txn: int, n_read: int, n_write: int):
-        key = (n_txn, n_read, n_write)
+    def _fn(self, n_txn: int, n_read: int, n_write: int, search_iters: int):
+        key = (self._cap, n_txn, n_read, n_write, search_iters)
         if key not in self._fns:
             self._fns[key] = build_sharded_resolver(
-                self._mesh, cap=self._cap, n_txn=n_txn, n_read=n_read, n_write=n_write
+                self._mesh, cap=self._cap, n_txn=n_txn, n_read=n_read,
+                n_write=n_write, search_iters=search_iters,
             )
         return self._fns[key]
 
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
         validate_batch(commit_version, txns, self._oldest)
-        if commit_version <= self._last_commit:
-            raise ValueError(
-                f"commit_version {commit_version} not after last batch {self._last_commit}"
-            )
         B = len(txns)
         if B == 0:
+            if commit_version <= self._last_commit:
+                raise ValueError(
+                    f"commit_version {commit_version} not after last batch "
+                    f"{self._last_commit}"
+                )
             self._last_commit = commit_version
             return []
         rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
             txns, self._oldest, self._offset, self._max_key_bytes
         )
-        R, Wn = rbv.shape[0], wbv.shape[0]
-
-        fn = self._fn(Bp, R, Wn)
-        verdict, new_ks, new_vs, new_counts = fn(
-            self._ks, self._vs, self._dev_counts, self._lo, self._hi,
-            rbv, rev, rtv, wbv, wev, wtv,
-            snap_p, active_p, np.int32(self._offset(commit_version)),
+        codes = self.resolve_arrays(
+            commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p
         )
-        counts = np.asarray(new_counts)
-        if counts.max() > self._cap:
-            raise RuntimeError(
-                f"partition boundary overflow ({counts.max()} > cap {self._cap}); "
-                "raise capacity or remove_before more often"
+        return [Verdict(int(c)) for c in codes[:B]]
+
+    def resolve_arrays(
+        self, commit_version: int, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+        sync: bool = True,
+    ):
+        """Packed fast path, mirroring DeviceConflictSet.resolve_arrays.
+
+        sync=True: fetch verdicts; handle fast-search fallback (full-depth
+        replay) and capacity regrow inline.
+
+        sync=False: PIPELINED — dispatch and return the device verdict array
+        without waiting; deferred convergence/capacity validity folds into a
+        replicated device flag drained by check_pipelined()."""
+        if commit_version <= self._last_commit:
+            raise ValueError(
+                f"commit_version {commit_version} not after last batch {self._last_commit}"
             )
-        self._ks, self._vs, self._counts = new_ks, new_vs, counts
-        self._dev_counts = new_counts
-        self._last_commit = commit_version
-        codes = np.asarray(verdict)[:B]
-        return [Verdict(int(c)) for c in codes]
+        Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
+        commit_off = np.int32(self._offset(commit_version))
+        fast_iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+
+        if not sync:
+            # a batch adds at most 2*Wn boundaries per partition; if the
+            # host-tracked upper bound could overflow, drain the pipeline —
+            # and if genuinely near capacity, go through sync (which regrows)
+            if self._counts_ub.max() + 2 * Wn > self._cap:
+                self.check_pipelined()
+                if self._counts_ub.max() + 2 * Wn > self._cap:
+                    return np.asarray(
+                        self.resolve_arrays(
+                            commit_version, rbv, rev, rtv, wbv, wev, wtv,
+                            snap_p, active_p, sync=True,
+                        )
+                    )
+            fn = self._fn(Bp, R, Wn, fast_iters)
+            verdict, nks, nvs, ncnt, nbidx, _conv, ok = fn(
+                self._ks, self._vs, self._dev_counts, self._bidx,
+                self._lo, self._hi,
+                rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                commit_off, self._dev_ok,
+            )
+            self._ks, self._vs, self._dev_counts, self._bidx = nks, nvs, ncnt, nbidx
+            self._dev_ok = ok
+            self._counts = None  # unknown until drained
+            self._counts_ub = self._counts_ub + 2 * Wn
+            self._pipelined_since_check += 1
+            self._last_commit = commit_version
+            return verdict
+
+        while True:
+            pre = (self._ks, self._vs, self._dev_counts, self._bidx, self._counts)
+            iters = fast_iters
+            while True:
+                fn = self._fn(Bp, R, Wn, iters)
+                verdict, nks, nvs, ncnt, nbidx, conv, _ok = fn(
+                    self._ks, self._vs, self._dev_counts, self._bidx,
+                    self._lo, self._hi,
+                    rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                    commit_off, self._dev_ok,
+                )
+                if bool(np.asarray(conv)):
+                    break
+                # a word0-prefix bucket deeper than 2**iters on some
+                # partition: replay at full depth (kernel is pure)
+                self.search_fallbacks += 1
+                iters = _levels(self._cap) + 1
+            counts = np.asarray(ncnt).astype(np.int64)
+            if counts.max() <= self._cap:
+                self._ks, self._vs, self._bidx = nks, nvs, nbidx
+                self._counts = counts
+                self._counts_ub = counts.copy()
+                self._dev_counts = ncnt
+                self._last_commit = commit_version
+                break
+            # partition overflow: regrow from the pre-batch state (valid:
+            # the kernel does not donate its inputs) and replay
+            self.regrows += 1
+            new_cap = self._cap
+            while new_cap < counts.max():
+                new_cap *= 2
+            self._init_state(
+                new_cap, np.asarray(pre[0]), np.asarray(pre[1]),
+                pre[4] if pre[4] is not None else np.asarray(pre[2]).astype(np.int64),
+            )
+        return np.asarray(verdict)
+
+    def check_pipelined(self) -> None:
+        """Drain the deferred validity of sync=False resolves (ONE replicated
+        device flag + the live counts).  Raises if any batch needed the
+        full-depth search fallback or overflowed a partition; the stream must
+        then be replayed through sync=True resolves on a fresh instance (the
+        kernel is pure, so the host-side batch stream is the source of
+        truth)."""
+        if self._pipelined_since_check == 0:
+            return
+        n = self._pipelined_since_check
+        self._pipelined_since_check = 0
+        if not bool(np.asarray(self._dev_ok)):
+            raise RuntimeError(
+                f"a pipelined batch among the last {n} failed its deferred"
+                " search-convergence/capacity check; replay through sync=True"
+            )
+        self._counts = np.asarray(self._dev_counts).astype(np.int64)
+        self._counts_ub = self._counts.copy()
 
     def remove_before(self, version: int) -> None:
         if version <= self._oldest:

@@ -147,3 +147,95 @@ def test_wide_ranges_parity():
             for _ in range(6)
         ]
         assert dev.resolve_batch(version, txns) == oracle.resolve_batch(version, txns)
+
+
+def test_shared_prefix_search_fallback():
+    """Adversarial batch: >2**FAST_SEARCH_ITERS boundaries share one
+    word0-prefix bucket, so the fast bucketed search cannot converge and the
+    sync path must replay at full depth (device.py resolve_arrays fallback).
+    Verdicts must still match the oracle exactly."""
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    dev = DeviceConflictSet(capacity=1 << 14)
+    ref = OracleConflictSet()
+
+    # 3000 distinct point writes, all sharing the 2-byte prefix ZZ: their
+    # ~6000 endpoint boundaries all land in one 16-bit prefix bucket
+    keys = [b"ZZ%04d" % i for i in range(3000)]
+    fill = [TxInfo(0, [], [(k, k + b"\x00")]) for k in keys]
+    assert dev.resolve_batch(10, fill) == ref.resolve_batch(10, fill)
+    assert dev.search_fallbacks == 0  # state was shallow during the insert
+
+    # now any read into that bucket needs a deeper-than-2**11 window
+    probes = [
+        TxInfo(5, [(b"ZZ1500", b"ZZ1501")], [(b"q", b"q\x00")]),
+        TxInfo(5, [(b"ZZ0001", b"ZZ2999")], []),
+        TxInfo(5, [(b"yy", b"yz")], [(b"ZZ2000", b"ZZ2000\x00")]),
+    ]
+    got = dev.resolve_batch(20, probes)
+    want = ref.resolve_batch(20, probes)
+    assert got == want
+    assert dev.search_fallbacks >= 1, "full-depth replay never engaged"
+
+
+def test_pipelined_deferred_failure_replays_through_sync():
+    """A pipelined (sync=False) stream hits the adversarial shared-prefix
+    case: the deferred convergence check must fail at drain time, and
+    replaying the same host-side TxInfo stream through sync resolves on a
+    fresh instance must produce oracle-exact verdicts (the documented
+    recovery contract of check_pipelined)."""
+    import numpy as np
+
+    import pytest
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet, pack_batch
+
+    keys = [b"ZZ%04d" % i for i in range(3000)]
+    stream = [
+        (10, [TxInfo(0, [], [(k, k + b"\x00")]) for k in keys]),
+        (20, [TxInfo(5, [(b"ZZ1500", b"ZZ1501")], [(b"q", b"q\x00")]),
+              TxInfo(5, [(b"ZZ0001", b"ZZ2999")], [])]),
+    ]
+
+    dev = DeviceConflictSet(capacity=1 << 14)
+    for v, txns in stream:
+        packed = pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)
+        dev.resolve_arrays(v, *packed[:-1], sync=False)
+    with pytest.raises(RuntimeError, match="deferred"):
+        dev.check_pipelined()
+
+    # recovery: replay the stream sync on a fresh set; parity vs oracle
+    fresh = DeviceConflictSet(capacity=1 << 14)
+    ref = OracleConflictSet()
+    for v, txns in stream:
+        assert fresh.resolve_batch(v, txns) == ref.resolve_batch(v, txns)
+    assert fresh.search_fallbacks >= 1
+
+
+def test_regrow_preserves_pending_pipelined_failure():
+    """A capacity regrow (sync path) must NOT reset the pipelined-stream
+    validity accumulator: a deferred failure recorded before the regrow
+    still surfaces at the next check_pipelined()."""
+    import pytest
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet, pack_batch
+
+    dev = DeviceConflictSet(capacity=1 << 14)
+
+    def packed(txns):
+        return pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)[:-1]
+
+    # batch 1 (pipelined, converges): fill one prefix bucket deep
+    keys = [b"ZZ%04d" % i for i in range(3000)]
+    dev.resolve_arrays(10, *packed([TxInfo(0, [], [(k, k + b"\x00")]) for k in keys]), sync=False)
+    # batch 2 (pipelined): probes the deep bucket -> deferred non-convergence
+    dev.resolve_arrays(
+        20, *packed([TxInfo(5, [(b"ZZ1500", b"ZZ1501")], [(b"q", b"q\x00")])]), sync=False
+    )
+    # batch 3 (sync): a mass insert that overflows capacity and regrows.
+    # 6000 more distinct prefixes pushes the boundary count past 2**14.
+    more = [b"YY%04d" % i for i in range(6000)]
+    dev.resolve_batch(30, [TxInfo(25, [], [(k, k + b"\x00")]) for k in more])
+    assert dev.capacity > (1 << 14), "test setup: regrow never happened"
+    with pytest.raises(RuntimeError, match="deferred"):
+        dev.check_pipelined()
