@@ -29,6 +29,7 @@ class RecoverableCluster:
         n_resolvers: int = 1,
         n_storage_shards: int = 1,
         n_tlogs: int = 2,
+        n_proxies: int = 2,   # multi-proxy by default, like the reference
         n_coordinators: int = 3,
         conflict_backend: Callable[..., object] | None = None,
         knobs: CoreKnobs | None = None,
@@ -110,6 +111,7 @@ class RecoverableCluster:
             conflict_backend=make_cs,
             resolver_splits=resolver_splits,
             n_tlogs=n_tlogs,
+            n_proxies=n_proxies,
             cstate=cstate,
             fs=self.fs,
             restart=restart,
@@ -125,7 +127,8 @@ class RecoverableCluster:
         )
         self.controller.ratekeeper = self.ratekeeper
         # generation 1 was recruited before the ratekeeper existed
-        self.controller.generation.proxy.ratekeeper = self.ratekeeper
+        for p in self.controller.generation.proxies:
+            p.ratekeeper = self.ratekeeper
 
     def database(self) -> Database:
         proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")

@@ -132,6 +132,7 @@ class CommitProxy:
         self.c_committed = self.counters.counter("txns_committed")
         self.c_conflicted = self.counters.counter("txns_conflicted")
         self.c_batches = self.counters.counter("commit_batches")
+        self.c_throttled = self.counters.counter("mvcc_window_throttles")
         self._pending: list[_PendingCommit] = []
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
         self._tasks = [
@@ -265,6 +266,8 @@ class CommitProxy:
         # minus the newest fully-committed version) is capped at the MVCC
         # window.  Rare in healthy clusters; bites when storage/logging lag.
         window = self.knobs.mvcc_window_versions
+        if self.committed_version.get() < version - window:
+            self.c_throttled.add(1)
         while self.committed_version.get() < version - window:
             await wait_any(
                 [
