@@ -20,6 +20,7 @@ from typing import Any
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_any
+from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, Future, Promise, TaskPriority, TimedOut
 
 
@@ -122,6 +123,7 @@ class Coordinator:
     async def _serve_read(self) -> None:
         while True:
             req = await self.read_stream.next()
+            await maybe_delay(self.loop, "coord.delay_read")
             r: ReadRegRequest = req.payload
             if r.read_gen > self.promised:
                 self.promised = r.read_gen
@@ -132,6 +134,7 @@ class Coordinator:
     async def _serve_write(self) -> None:
         while True:
             req = await self.write_stream.next()
+            await maybe_delay(self.loop, "coord.delay_write")
             r: WriteRegRequest = req.payload
             if r.write_gen >= self.promised:
                 self.promised = r.write_gen

@@ -39,10 +39,22 @@ class RecoverableCluster:
                                 # durable=False is for conflict benches only)
         fs=None,                # SimFilesystem to reuse (cluster restart)
         restart: bool = False,  # bootstrap from fs contents
+        chaos: bool = False,    # BUGGIFY fault injection + randomized knobs
+                                # (the reference enables both in every sim
+                                # run — flow/flow.h:65, Knobs.cpp:33-34).
+                                # Module-global: the newest cluster's setting
+                                # wins if two clusters are alive at once.
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
-        self.knobs = knobs or CoreKnobs()
+        from ..runtime import buggify as _buggify
+
+        if chaos:
+            _buggify.enable(self.rng)
+            self.knobs = knobs or CoreKnobs(randomize=self.rng)
+        else:
+            _buggify.disable()
+            self.knobs = knobs or CoreKnobs()
         self.trace = TraceCollector(clock=self.loop.now)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))

@@ -43,6 +43,7 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all, wait_any
 from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
+from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.trace import CounterCollection
 
 
@@ -162,7 +163,11 @@ class CommitProxy:
             full = len(self._pending) / max(self.knobs.COMMIT_BATCH_MAX_COUNT, 1)
             lo, hi = self.knobs.COMMIT_BATCH_INTERVAL_MIN, self.knobs.COMMIT_BATCH_INTERVAL_MAX
             self._batch_interval = min(hi, max(lo, hi * (1.0 - min(full * 50, 1.0))))
-            if self._pending or idle >= self.knobs.COMMIT_BATCH_INTERVAL_MAX:
+            if (
+                self._pending
+                or idle >= self.knobs.COMMIT_BATCH_INTERVAL_MAX
+                or buggify("proxy.early_batch")
+            ):
                 batch, self._pending = self._pending, []
                 idle = 0.0
                 # cap batch size (the reference's COMMIT_BATCH_MAX_COUNT):
@@ -218,7 +223,9 @@ class CommitProxy:
         self._req_num += 1
         gv: GetCommitVersionReply = await self._retry_reply(
             self.sequencer,
-            GetCommitVersionRequest(self.name, self._req_num),
+            GetCommitVersionRequest(
+                self.name, self._req_num, self.committed_version.get()
+            ),
             deadline,
         )
         prev_v, version = gv.prev_version, gv.version
@@ -260,12 +267,15 @@ class CommitProxy:
             for i in range(len(batch))
         ]
 
-        # phase 4 precondition — the MVCC-window commit throttle (:850-870):
-        # storage servers must never be handed durable versions that are not
-        # fully committed, so the semi-committed span (this batch's version
-        # minus the newest fully-committed version) is capped at the MVCC
-        # window.  Rare in healthy clusters; bites when storage/logging lag.
-        window = self.knobs.mvcc_window_versions
+        # phase 4 precondition — the versions-in-flight commit throttle
+        # (:850-870): the semi-committed span (this batch's version minus the
+        # newest fully-committed version) is capped at MAX_VERSIONS_IN_FLIGHT
+        # (the reference's bound — NOT the 5s MVCC read window: a window-sized
+        # bound deadlocks a recovering pipeline, because committed can only
+        # advance through the very batches the throttle parks).  The
+        # sequencer's assignment clamp keeps the gap below this in steady
+        # state; this is the last line of defense.
+        window = self.knobs.MAX_VERSIONS_IN_FLIGHT
         if self.committed_version.get() < version - window:
             self.c_throttled.add(1)
         while self.committed_version.get() < version - window:
@@ -281,6 +291,7 @@ class CommitProxy:
                     raise TimedOut("MVCC-window throttle never cleared")
 
         # phase 4: tag committed mutations, push to TLogs
+        await maybe_delay(self.loop, "proxy.delay_tlog_push")
         by_tag: dict[str, list[Mutation]] = {}
         for pc, v in zip(batch, verdicts):
             if v != Verdict.COMMITTED:
@@ -454,6 +465,7 @@ class CommitProxy:
                 # out and re-route; answering here with a stale version would
                 # break causality (ref MasterProxyServer.actor.cpp:1002).
                 await self.loop.delay(0.05, TaskPriority.GET_LIVE_VERSION)
+            await maybe_delay(self.loop, "proxy.delay_grv")
             version = self.committed_version.get()
             for r in reqs:
                 r.reply(GetReadVersionReply(version))

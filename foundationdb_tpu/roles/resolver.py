@@ -25,6 +25,7 @@ from .types import (
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import CounterCollection
@@ -65,6 +66,7 @@ class Resolver:
 
     async def _resolve_one(self, req) -> None:
         r: ResolveTransactionBatchRequest = req.payload
+        await maybe_delay(self.loop, "resolver.delay_resolve")
         await self.version.when_at_least(r.prev_version)
         if self.version.get() >= r.version:
             # duplicate delivery (proxy retry after timeout): re-reply the

@@ -13,6 +13,7 @@ from __future__ import annotations
 from ..roles.types import GetCommitVersionReply, GetCommitVersionRequest, Version
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, Future, Promise, TaskPriority
 from ..runtime.knobs import CoreKnobs
 
@@ -67,6 +68,7 @@ class Sequencer:
         self.knobs = knobs
         self._last_assigned: Version = start_version
         self._prev: Version = start_version
+        self._max_committed: Version = start_version
         self._epoch_start = loop.now()
         self._version_at_epoch = start_version
         self.stream = RequestStream(process, self.WLT)
@@ -88,17 +90,25 @@ class Sequencer:
 
     def _next_version(self) -> Version:
         # advance with the clock: version ≈ epoch_version + elapsed * rate
-        # (masterserver getVersion ties versions to wall time x 1e6)
+        # (masterserver getVersion ties versions to wall time x 1e6) — but
+        # never more than MAX_VERSIONS_IN_FLIGHT past the newest committed
+        # version the proxies have reported (the reference's backpressure:
+        # a stalled commit pipeline must slow the version clock, or every
+        # later batch throttles and the cluster spirals into recovery)
         target = self._version_at_epoch + int(
             (self.loop.now() - self._epoch_start) * self.knobs.VERSIONS_PER_SECOND
         )
-        return max(self._last_assigned + 1, target)
+        ceiling = self._max_committed + self.knobs.MAX_VERSIONS_IN_FLIGHT
+        return max(self._last_assigned + 1, min(target, ceiling))
 
     async def _serve(self) -> None:
         while True:
             req = await self.stream.next()
+            await maybe_delay(self.loop, "sequencer.delay_reply")
             r = req.payload
             assert isinstance(r, GetCommitVersionRequest)
+            if r.committed_version > self._max_committed:
+                self._max_committed = r.committed_version
             cache = self._replies.setdefault(r.requesting_proxy, {})
             cached = cache.get(r.request_num)
             if cached is not None:

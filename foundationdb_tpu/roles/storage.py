@@ -36,6 +36,7 @@ from .types import (
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
+from ..runtime.buggify import maybe_delay
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 
@@ -219,6 +220,7 @@ class StorageServer:
             if self.tlog is None:  # no log system yet (pre-first-recovery)
                 await self.loop.delay(0.05, TaskPriority.STORAGE_SERVER)
                 continue
+            await maybe_delay(self.loop, "storage.delay_pull")
             epoch = self._pull_epoch
             try:
                 reply = await self.tlog.get_reply(
@@ -291,6 +293,7 @@ class StorageServer:
 
     async def _getvalue_one(self, req) -> None:
         r: GetValueRequest = req.payload
+        await maybe_delay(self.loop, "storage.delay_read")
         try:
             await self._wait_version(r.version)
         except (TransactionTooOld, FutureVersion) as e:

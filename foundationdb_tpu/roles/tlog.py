@@ -34,6 +34,7 @@ from .types import (
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.serialize import (
     BinaryReader,
@@ -126,6 +127,9 @@ class TLog:
 
     async def _commit_one(self, req) -> None:
         r: TLogCommitRequest = req.payload
+        if buggify("tlog.drop_push"):
+            return  # lost push: the proxy's idempotent retry re-sends it
+        await maybe_delay(self.loop, "tlog.delay_commit")
         if self.locked:
             return  # locked by recovery: never ack, the old generation ends
         await self.version.when_at_least(r.prev_version)
@@ -165,8 +169,10 @@ class TLog:
             r: TLogPeekRequest = req.payload
             q = self._tags.get(r.tag, [])
             i = bisect.bisect_left(q, r.begin_version, key=lambda e: e[0])
-            entries = q[i : i + 1000]
-            truncated = i + 1000 < len(q)
+            # rare short reads exercise the storage re-peek path
+            lim = 1 if buggify("tlog.peek_truncate") else 1000
+            entries = q[i : i + lim]
+            truncated = i + lim < len(q)
             # on truncation, end_version must not skip unfetched entries
             end = entries[-1][0] + 1 if truncated else self.version.get() + 1
             req.reply(
@@ -181,6 +187,8 @@ class TLog:
     async def _serve_pop(self) -> None:
         while True:
             req = await self.pop_stream.next()
+            if buggify("tlog.drop_pop"):
+                continue  # pops are advisory; storage re-pops as it advances
             r: TLogPopRequest = req.payload
             self._poppable[r.tag] = max(self._poppable.get(r.tag, 0), r.upto_version)
             q = self._tags.get(r.tag, [])

@@ -127,6 +127,10 @@ class GetCommitVersionRequest:
 
     requesting_proxy: str
     request_num: int = 0
+    # the proxy's newest fully-committed version, piggybacked so the
+    # sequencer can bound version assignment (MAX_VERSIONS_IN_FLIGHT
+    # backpressure, the reference's masterserver getVersion contract)
+    committed_version: Version = 0
 
 
 @dataclasses.dataclass

@@ -8,15 +8,15 @@ flow/flow.cpp:189-214): an enabled site fires with `fire_prob` each time.
 
 from __future__ import annotations
 
-from .core import DeterministicRandom
+from .core import DeterministicRandom, TaskPriority
 
 _state: dict[str, bool] = {}
 _rng: DeterministicRandom | None = None
 _enable_prob = 0.25
-_fire_prob = 0.25
+_fire_prob = 0.05
 
 
-def enable(rng: DeterministicRandom, enable_prob: float = 0.25, fire_prob: float = 0.25) -> None:
+def enable(rng: DeterministicRandom, enable_prob: float = 0.25, fire_prob: float = 0.05) -> None:
     global _rng, _enable_prob, _fire_prob
     _rng = rng.split()
     _enable_prob = enable_prob
@@ -41,3 +41,11 @@ def buggify(site: str) -> bool:
     if site not in _state:
         _state[site] = _rng.coinflip(_enable_prob)
     return _state[site] and _rng.coinflip(_fire_prob)
+
+
+async def maybe_delay(loop, site: str, seconds: float = 0.02) -> None:
+    """Rare injected delay at `site` (no-op outside simulation chaos mode).
+    The classic BUGGIFY(delay(...)) pattern the reference sprinkles through
+    every role (e.g. TLogServer.actor.cpp, MasterProxyServer.actor.cpp)."""
+    if buggify(site):
+        await loop.delay(seconds, TaskPriority.DEFAULT_ENDPOINT)

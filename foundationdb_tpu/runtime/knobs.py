@@ -48,6 +48,11 @@ class CoreKnobs(Knobs):
         self.init("COMMIT_BATCH_MAX_COUNT", 32768)
         # grv batching
         self.init("GRV_BATCH_INTERVAL", 0.0005)
+        # how far version assignment may outrun the newest committed version
+        # (reference MAX_VERSIONS_IN_FLIGHT, fdbserver/Knobs.cpp: 100e6) —
+        # the sequencer clamps assignment and the proxy's phase-4 throttle
+        # parks batches past it
+        self.init("MAX_VERSIONS_IN_FLIGHT", 100_000_000)
         # resolver
         self.init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 30)
         self.init("SAMPLE_OFFSET_PER_KEY", 100)

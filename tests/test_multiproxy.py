@@ -6,9 +6,9 @@ Reference behaviours under test:
     max committed version over ALL proxies, confirmed live with the TLogs —
     so a client's write acknowledged by proxy A is visible to a read version
     served by proxy B, and a deposed proxy (locked TLogs) never answers.
-  * the MVCC-window commit throttle (:850-870): a batch whose version runs
-    more than the MVCC window ahead of the newest fully-committed version
-    parks until the gap closes.
+  * the versions-in-flight commit throttle (:850-870): a batch whose
+    version runs more than MAX_VERSIONS_IN_FLIGHT ahead of the newest
+    fully-committed version parks until the gap closes.
 """
 
 from foundationdb_tpu.control.recoverable import RecoverableCluster
@@ -64,12 +64,11 @@ def test_both_proxies_carry_commits():
 
 def test_mvcc_window_throttle_engages_and_releases():
     """Clog every proxy<->TLog link so commits cannot become durable while
-    the version clock runs past a shrunken MVCC window: the phase-4 throttle
-    must engage (counter observable), and after the clog heals every parked
-    commit must land."""
+    the version clock runs past a shrunken versions-in-flight bound: the
+    phase-4 throttle must engage (counter observable), and after the clog
+    heals every parked commit must land."""
     knobs = CoreKnobs()
-    knobs.MAX_WRITE_TRANSACTION_LIFE = 0.05   # window = 50K versions = 50ms
-    knobs.MAX_READ_TRANSACTION_LIFE = 0.05
+    knobs.MAX_VERSIONS_IN_FLIGHT = 50_000    # 50ms of version clock
     c = RecoverableCluster(seed=83, n_proxies=2, knobs=knobs)
     db = c.database()
     gen = c.controller.generation
