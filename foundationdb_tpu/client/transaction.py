@@ -120,8 +120,20 @@ class Database:
         tr = self.create_transaction()
         current = await tr.get(key, snapshot=True)
         v = await tr.get_read_version()
-        refs = self._smap.member_for_key(key)
-        return refs["watch"].get_reply(WatchValueRequest(key, current, v))
+
+        async def waiter():
+            # loadBalance over the shard's team: a dead replica answers
+            # BrokenPromise, so re-register against another one
+            while True:
+                refs = self._rng.random_choice(self._smap.member_for_key(key))
+                try:
+                    return await refs["watch"].get_reply(
+                        WatchValueRequest(key, current, v)
+                    )
+                except BrokenPromise:
+                    await self.loop.delay(0.05)
+
+        return self.loop.spawn(waiter())
 
     async def run(self, fn, max_retries: int = 50):
         """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
@@ -234,8 +246,13 @@ class Transaction:
     # -- reads --------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
         v = await self.get_read_version()
+        # loadBalance (fdbrpc/LoadBalance.actor.h:159): pick a random replica
+        # of the shard's team per attempt; _reply_rerouted re-picks on a
+        # dead endpoint, so reads fail over to the surviving replicas
         reply = await self._reply_rerouted(
-            lambda: self.db._smap.member_for_key(key)["getvalue"],
+            lambda: self.db._rng.random_choice(
+                self.db._smap.member_for_key(key)
+            )["getvalue"],
             GetValueRequest(key, v),
         )
         if not snapshot:
@@ -255,7 +272,9 @@ class Transaction:
                 continue
             b, e = clip
             reply = await self._reply_rerouted(
-                lambda idx=idx: self.db._smap.members[idx]["getkeyvalues"],
+                lambda idx=idx: self.db._rng.random_choice(
+                    self.db._smap.members[idx]
+                )["getkeyvalues"],
                 GetKeyValuesRequest(b, e, v, limit - len(out)),
             )
             out.extend(reply.data)

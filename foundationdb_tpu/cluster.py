@@ -92,7 +92,7 @@ class SimCluster:
 
         self.proxy_proc = self.net.create_process("proxy")
         storage_tag_map = KeyPartitionMap(
-            self.storage_splits, [f"ss-{i}" for i in range(n_storage_shards)]
+            self.storage_splits, [[f"ss-{i}"] for i in range(n_storage_shards)]
         )
         self.proxy = CommitProxy(
             self.proxy_proc,
@@ -115,14 +115,20 @@ class SimCluster:
     def _ref(self, process, endpoint) -> RequestStreamRef:
         return RequestStreamRef(self.net, process, endpoint)
 
+    def storage_teams(self):
+        """Storage servers grouped per shard (single-replica teams)."""
+        return [[ss] for ss in self.storage]
+
     def database(self, process=None) -> Database:
         proc = process or self.client_proc
         storage_members = [
-            {
-                "getvalue": self._ref(proc, ss.getvalue_stream.endpoint),
-                "getkeyvalues": self._ref(proc, ss.getkv_stream.endpoint),
-                "watch": self._ref(proc, ss.watch_stream.endpoint),
-            }
+            [
+                {
+                    "getvalue": self._ref(proc, ss.getvalue_stream.endpoint),
+                    "getkeyvalues": self._ref(proc, ss.getkv_stream.endpoint),
+                    "watch": self._ref(proc, ss.watch_stream.endpoint),
+                }
+            ]
             for ss in self.storage
         ]
         view = ClusterView(

@@ -299,16 +299,42 @@ class ClusterController:
         recovery_version = min(r.end_version for r in alive)
         return recovery_version, self._merge_tlog_replies(alive, recovery_version)
 
+    @staticmethod
+    def _parse_tag(tag: str) -> tuple[int, int]:
+        """Storage tag -> (shard, replica).  Tags are per storage SERVER
+        (the reference's Tag(locality, id): each team member gets its own
+        tag and the proxy tags mutations with the whole team): "ss-3-r1" is
+        shard 3's replica 1; legacy "ss-3" is replica 0."""
+        parts = tag.split("-")
+        shard = int(parts[1])
+        replica = int(parts[2][1:]) if len(parts) > 2 else 0
+        return shard, replica
+
     def _tag_tlogs(self, tag: str, n_tlogs: int | None = None) -> list[int]:
         """TLog replica set for a tag: primary + next (2x log replication —
         the reference replicates each mutation to a TLog team under policy;
         one TLog loss keeps every tag recoverable).  Pass `n_tlogs` to
         compute a PREVIOUS epoch's replica map during disk recovery."""
         n = self.n_tlogs if n_tlogs is None else n_tlogs
-        primary = int(tag.split("-")[-1]) % n
+        shard, replica = self._parse_tag(tag)
+        primary = (shard + replica) % n
         if n == 1:
             return [0]
         return [primary, (primary + 1) % n]
+
+    def _storage_teams(self) -> list[list["StorageServer"]]:
+        """Storage servers grouped by shard, replicas in replica order (the
+        keyServers team map: every shard is served by a team of servers all
+        pulling their own tag for the same key range)."""
+        teams: list[list] = [[] for _ in range(len(self.storage_splits) + 1)]
+        for ss in self.storage:
+            shard, _ = self._parse_tag(ss.tag)
+            teams[shard].append(ss)
+        for i, t in enumerate(teams):
+            if not t:
+                raise ValueError(f"shard {i} has no storage servers")
+            t.sort(key=lambda s: self._parse_tag(s.tag)[1])
+        return teams
 
     def _cc_proc(self) -> SimProcess:
         if not hasattr(self, "_cc_process"):
@@ -369,7 +395,9 @@ class ClusterController:
                 )
             )
 
-        tags = [f"ss-{i}" for i in range(len(self.storage_splits) + 1)]
+        teams = self._storage_teams()
+        tag_teams = [[ss.tag for ss in team] for team in teams]
+        all_tags = [t for team in tag_teams for t in team]
         proxies: list[CommitProxy] = []
         for i in range(self.n_proxies):
             proxy_proc = self._new_proc(f"proxy{i}")
@@ -387,8 +415,8 @@ class ClusterController:
                     RequestStreamRef(self.net, proxy_proc, t.commit_stream.endpoint)
                     for t in tlogs
                 ],
-                storage_tags=KeyPartitionMap(self.storage_splits, tags),
-                tag_to_tlogs={t: self._tag_tlogs(t) for t in tags},
+                storage_tags=KeyPartitionMap(self.storage_splits, tag_teams),
+                tag_to_tlogs={t: self._tag_tlogs(t) for t in all_tags},
                 start_version=recovery_version + 1_000_000,
                 tlog_confirm_refs=[
                     RequestStreamRef(self.net, proxy_proc, t.confirm_stream.endpoint)
@@ -441,12 +469,15 @@ class ClusterController:
         view.smap = KeyPartitionMap(
             self.storage_splits,
             [
-                {
-                    "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
-                    "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
-                    "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
-                }
-                for ss in self.storage
+                [
+                    {
+                        "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
+                        "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
+                        "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
+                    }
+                    for ss in team
+                ]
+                for team in self._storage_teams()
             ],
         )
         view.epoch = self.epoch

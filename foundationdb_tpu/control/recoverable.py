@@ -28,6 +28,7 @@ class RecoverableCluster:
         seed: int = 0,
         n_resolvers: int = 1,
         n_storage_shards: int = 1,
+        storage_replication: int = 2,  # team size: replicas per shard
         n_tlogs: int = 2,
         n_proxies: int = 2,   # multi-proxy by default, like the reference
         n_coordinators: int = 3,
@@ -82,32 +83,34 @@ class RecoverableCluster:
             for i in range(n_coordinators)
         ]
 
-        # storage servers persist across generations
+        # storage servers persist across generations; each shard is served
+        # by a TEAM of `storage_replication` servers, each with its own tag
+        # (the reference's per-server Tag + keyServers teams)
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
-            p = self.net.create_process(f"storage-{i}")
-            if self.fs is not None:
-                from ..storage.kvstore import DurableMemoryKeyValueStore
+            for r in range(storage_replication):
+                p = self.net.create_process(f"storage-{i}r{r}")
+                if self.fs is not None:
+                    from ..storage.kvstore import DurableMemoryKeyValueStore
 
-                if restart:
-                    store = DurableMemoryKeyValueStore.recover(
-                        self.fs, f"ss{i}.kv", p
-                    )
+                    fname = f"ss{i}r{r}.kv"
+                    if restart:
+                        store = DurableMemoryKeyValueStore.recover(self.fs, fname, p)
+                    else:
+                        store = DurableMemoryKeyValueStore(self.fs, fname, p)
+                    start_version = store.meta.get("durable_version", 0)
                 else:
-                    store = DurableMemoryKeyValueStore(self.fs, f"ss{i}.kv", p)
-                start_version = store.meta.get("durable_version", 0)
-            else:
-                store = MemoryKeyValueStore()
-                start_version = 0
-            # initial refs are dummies; the controller rewires on first recovery
-            self.storage.append(
-                StorageServer(
-                    p, self.loop, self.knobs,
-                    tlog_peek_ref=None, tlog_pop_ref=None,
-                    tag=f"ss-{i}", store=store,
-                    start_version=start_version,
+                    store = MemoryKeyValueStore()
+                    start_version = 0
+                # initial refs are dummies; the controller rewires on first recovery
+                self.storage.append(
+                    StorageServer(
+                        p, self.loop, self.knobs,
+                        tlog_peek_ref=None, tlog_pop_ref=None,
+                        tag=f"ss-{i}-r{r}", store=store,
+                        start_version=start_version,
+                    )
                 )
-            )
 
         cc_proc = self.net.create_process("cc-election")
         cstate = CoordinatedState(
@@ -141,6 +144,10 @@ class RecoverableCluster:
         # generation 1 was recruited before the ratekeeper existed
         for p in self.controller.generation.proxies:
             p.ratekeeper = self.ratekeeper
+
+    def storage_teams(self):
+        """Storage servers grouped per shard (replicas in replica order)."""
+        return self.controller._storage_teams()
 
     def database(self) -> Database:
         proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")

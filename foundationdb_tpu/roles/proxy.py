@@ -109,8 +109,11 @@ class CommitProxy:
         self.tlogs = tlog_refs
         self.tags = storage_tags
         # which TLog replicas store each tag (TagPartitionedLogSystem's
-        # tag->log-team mapping); default: every tag on tlog 0
-        self.tag_to_tlogs = tag_to_tlogs or {t: [0] for t in storage_tags.members}
+        # tag->log-team mapping); default: every tag on tlog 0.  Each
+        # storage_tags member is a TEAM (list of per-server tags).
+        self.tag_to_tlogs = tag_to_tlogs or {
+            t: [0] for team in storage_tags.members for t in team
+        }
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
         self.name = process.name
@@ -298,11 +301,15 @@ class CommitProxy:
                 continue
             for m in pc.request.mutations:
                 if m.type == MutationType.CLEAR_RANGE:
-                    tags = self.tags.members_for_range(m.key, m.value)
+                    teams = self.tags.members_for_range(m.key, m.value)
                 else:
-                    tags = [self.tags.member_for_key(m.key)]
-                for tag in tags:
-                    by_tag.setdefault(tag, []).append(m)
+                    teams = [self.tags.member_for_key(m.key)]
+                # a member is a storage TEAM: every replica has its own tag
+                # and receives every mutation of its shard (the reference
+                # tags each mutation with the whole team's server tags)
+                for team in teams:
+                    for tag in team:
+                        by_tag.setdefault(tag, []).append(m)
         # every TLog sees every version (its prev->version chain must advance
         # even on empty batches) but only stores its own tags' mutations
         per_tlog: list[dict[str, list[Mutation]]] = [dict() for _ in self.tlogs]

@@ -1,0 +1,78 @@
+"""ConsistencyCheck — replica-equality verification after quiescence
+(fdbserver/workloads/ConsistencyCheck.actor.cpp checkDataConsistency +
+the QuietDatabase wait it runs under).
+
+For every shard team: wait until each live replica has applied a fresh read
+version (the quiet-database analog — nothing in flight below it), then read
+the replica's ENTIRE holdings at that version and assert byte equality
+across the team.  A dead replica is skipped (data distribution healing is
+the component that would re-replicate it); a team with NO live replica
+fails the check.
+"""
+
+from __future__ import annotations
+
+from .base import Workload
+from ..roles.types import GetKeyValuesRequest
+from ..rpc.stream import RequestStreamRef
+from ..runtime.combinators import timeout_error
+from ..runtime.core import TimedOut
+
+_END = b"\xff\xff\xff\xff\xff\xff\xff\xff"  # past any user key in the sim
+
+
+class ConsistencyCheckWorkload(Workload):
+    description = "ConsistencyCheck"
+
+    def __init__(self, quiesce_timeout: float = 30.0):
+        self.quiesce_timeout = quiesce_timeout
+        self.shards_checked = 0
+        self.replicas_compared = 0
+        self.rows_checked = 0
+
+    async def start(self, cluster, rng) -> None:
+        pass  # pure check-phase workload
+
+    async def check(self, cluster, rng) -> bool:
+        db = cluster.database()
+
+        async def grv(tr):
+            return await tr.get_read_version()
+
+        v = await db.run(grv)
+        proc = cluster.net.create_process(
+            f"cons-check-{rng.random_unique_id()[:6]}"
+        )
+        teams = cluster.storage_teams()
+        for shard, team in enumerate(teams):
+            live = [ss for ss in team if ss.process.alive]
+            if not live:
+                return False  # an entire team lost: data IS gone
+            datasets = []
+            for ss in live:
+                # quiet-database wait: the replica must catch up to v
+                try:
+                    await timeout_error(
+                        cluster.loop, ss.version.when_at_least(v),
+                        self.quiesce_timeout,
+                    )
+                except TimedOut:
+                    return False
+                ref = RequestStreamRef(cluster.net, proc, ss.getkv_stream.endpoint)
+                rep = await ref.get_reply(
+                    GetKeyValuesRequest(b"", _END, v, 1_000_000), timeout=10.0
+                )
+                datasets.append(rep.data)
+            self.replicas_compared += len(datasets)
+            self.rows_checked += len(datasets[0])
+            if any(d != datasets[0] for d in datasets[1:]):
+                return False
+            self.shards_checked += 1
+        return True
+
+    def metrics(self) -> dict:
+        return {
+            "shards_checked": self.shards_checked,
+            "replicas_compared": self.replicas_compared,
+            "rows_checked": self.rows_checked,
+        }

@@ -78,7 +78,11 @@ def test_status_document():
     doc = c.run_until(c.loop.spawn(main()), 60)
     assert doc["cluster"]["generation"]["state"] == "fully_recovered"
     assert doc["proxy"]["txns_committed"] >= 1
-    assert len(doc["storage"]) == 2
+    # 2 shards x 2 replicas: status lists every storage SERVER
+    assert len(doc["storage"]) == 4
+    assert {e["tag"] for e in doc["storage"]} == {
+        "ss-0-r0", "ss-0-r1", "ss-1-r0", "ss-1-r1"
+    }
     assert doc["resolvers"][0]["txns"] >= 1
     c.stop()
 
