@@ -1,0 +1,121 @@
+"""ReadWrite — the reference's throughput/latency benchmark workload
+(fdbserver/workloads/ReadWrite.actor.cpp: configurable read/write mix,
+per-operation latency samples, :252-270 metrics emission).
+
+Each client loops transactions of `reads_per_tx` point reads and
+`writes_per_tx` point writes over a uniform key pool for a fixed duration,
+recording GRV / read / commit latencies.  Metrics report op rates and
+p50/p90/p99 latencies — the repo counterpart of BASELINE.md's per-core
+ops/s rows, so perf regressions show up in CI.
+"""
+
+from __future__ import annotations
+
+from .base import Workload
+from ..client.transaction import RETRYABLE_ERRORS
+from ..runtime.combinators import wait_all
+
+
+def _key(i: int) -> bytes:
+    return b"rw/%06d" % i
+
+
+def percentile(sorted_xs: list[float], p: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(int(p * len(sorted_xs)), len(sorted_xs) - 1)
+    return sorted_xs[idx]
+
+
+class ReadWriteWorkload(Workload):
+    description = "ReadWrite"
+
+    def __init__(
+        self,
+        keys: int = 1000,
+        clients: int = 8,
+        duration: float = 5.0,
+        reads_per_tx: int = 9,
+        writes_per_tx: int = 1,
+        value_bytes: int = 16,
+    ):
+        self.keys = keys
+        self.clients = clients
+        self.duration = duration
+        self.reads_per_tx = reads_per_tx
+        self.writes_per_tx = writes_per_tx
+        self.value_bytes = value_bytes
+        self.committed = 0
+        self.retries = 0
+        self.grv_lat: list[float] = []
+        self.read_lat: list[float] = []
+        self.commit_lat: list[float] = []
+        self._elapsed = 0.0
+
+    async def setup(self, cluster, rng) -> None:
+        db = cluster.database()
+        val = b"x" * self.value_bytes
+        # chunked fills (one giant txn would blow batch limits)
+        for lo in range(0, self.keys, 500):
+
+            async def fill(tr, lo=lo):
+                for i in range(lo, min(lo + 500, self.keys)):
+                    tr.set(_key(i), val)
+
+            await db.run(fill)
+
+    async def start(self, cluster, rng) -> None:
+        db = cluster.database()
+        loop = cluster.loop
+        t_end = loop.now() + self.duration
+        val = b"y" * self.value_bytes
+
+        async def client(crng):
+            while loop.now() < t_end:
+                tr = db.create_transaction()
+                try:
+                    t0 = loop.now()
+                    await tr.get_read_version()
+                    self.grv_lat.append(loop.now() - t0)
+                    for _ in range(self.reads_per_tx):
+                        k = _key(crng.random_int(0, self.keys))
+                        t0 = loop.now()
+                        await tr.get(k)
+                        self.read_lat.append(loop.now() - t0)
+                    for _ in range(self.writes_per_tx):
+                        tr.set(_key(crng.random_int(0, self.keys)), val)
+                    t0 = loop.now()
+                    await tr.commit()
+                    self.commit_lat.append(loop.now() - t0)
+                    self.committed += 1
+                except RETRYABLE_ERRORS as e:
+                    self.retries += 1
+                    await tr.on_error(e)
+
+        t0 = loop.now()
+        await wait_all(
+            [loop.spawn(client(rng.split())) for _ in range(self.clients)]
+        )
+        self._elapsed = max(loop.now() - t0, 1e-9)
+
+    async def check(self, cluster, rng) -> bool:
+        return self.committed > 0
+
+    def metrics(self) -> dict:
+        out = {
+            "committed": self.committed,
+            "retries": self.retries,
+            "elapsed_s": round(self._elapsed, 3),
+            "tx_per_s": round(self.committed / self._elapsed, 1),
+            "reads_per_s": round(len(self.read_lat) / self._elapsed, 1),
+        }
+        for name, lat in (
+            ("grv", self.grv_lat),
+            ("read", self.read_lat),
+            ("commit", self.commit_lat),
+        ):
+            xs = sorted(lat)
+            out[f"{name}_p50_ms"] = round(percentile(xs, 0.50) * 1e3, 3)
+            out[f"{name}_p90_ms"] = round(percentile(xs, 0.90) * 1e3, 3)
+            out[f"{name}_p99_ms"] = round(percentile(xs, 0.99) * 1e3, 3)
+        return out

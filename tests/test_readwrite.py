@@ -1,0 +1,39 @@
+"""ReadWrite perf workload: rates + latency percentiles exist and behave
+(the repo counterpart of BASELINE.md's per-core ops/s rows — numbers to
+regress against; ref fdbserver/workloads/ReadWrite.actor.cpp:252-270)."""
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.workloads.base import run_workloads
+from foundationdb_tpu.workloads.readwrite import ReadWriteWorkload, percentile
+
+
+def test_percentile_helper():
+    xs = sorted([0.001 * i for i in range(100)])
+    assert percentile(xs, 0.50) == 0.050
+    assert percentile(xs, 0.99) == 0.099
+    assert percentile([], 0.5) == 0.0
+
+
+def test_readwrite_90_10_mix():
+    c = RecoverableCluster(seed=95, n_storage_shards=2)
+    rw = ReadWriteWorkload(keys=200, clients=4, duration=3.0,
+                           reads_per_tx=9, writes_per_tx=1)
+    metrics = run_workloads(c, [rw], deadline=600.0)
+    m = metrics["ReadWrite"]
+    assert m["committed"] > 50
+    assert m["tx_per_s"] > 10
+    # percentiles are populated and ordered
+    for op in ("grv", "read", "commit"):
+        assert 0 < m[f"{op}_p50_ms"] <= m[f"{op}_p90_ms"] <= m[f"{op}_p99_ms"]
+    c.stop()
+
+
+def test_readwrite_write_heavy_mix():
+    c = RecoverableCluster(seed=96, n_storage_shards=2)
+    rw = ReadWriteWorkload(keys=200, clients=4, duration=3.0,
+                           reads_per_tx=1, writes_per_tx=5)
+    metrics = run_workloads(c, [rw], deadline=600.0)
+    m = metrics["ReadWrite"]
+    assert m["committed"] > 50
+    assert m["commit_p50_ms"] > 0
+    c.stop()
