@@ -37,7 +37,13 @@ from ..roles.resolver import Resolver
 from ..roles.sequencer import Sequencer
 from ..roles.storage import StorageServer
 from ..roles.tlog import TLog
-from ..roles.types import TLogLockReply, TLogLockRequest, Version
+from ..roles.types import (
+    ResolutionMetricsRequest,
+    ResolutionSplitRequest,
+    TLogLockReply,
+    TLogLockRequest,
+    Version,
+)
 from ..rpc.network import Endpoint, SimNetwork, SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all, wait_any
@@ -109,6 +115,7 @@ class ClusterController:
         self.restart = restart
         self.epoch = 0
         self.recoveries = 0
+        self.resolver_moves = 0
         self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
         self.views: list[ClusterView] = []
@@ -134,6 +141,9 @@ class ClusterController:
         await self._recover(first=True)
         self._monitor_task = self.loop.spawn(
             self._monitor(), TaskPriority.COORDINATION, "cc-monitor"
+        )
+        self._balance_task = self.loop.spawn(
+            self._balance_resolvers(), TaskPriority.COORDINATION, "cc-balance"
         )
 
     # -- recovery state machine --------------------------------------------
@@ -489,6 +499,95 @@ class ClusterController:
         self.views.append(view)
         return view
 
+    # -- resolutionBalancing (masterserver.actor.cpp:964) --------------------
+    async def _balance_resolvers(self) -> None:
+        """Periodically move a resolver partition boundary toward the load:
+        sample per-resolver conflict-range counts, ask the busiest resolver
+        for a load-median split key, then install the new map everywhere at
+        a version boundary.  The boundary is made race-free by DRAINING the
+        commit plane (pause batchers, wait in-flight batches out) — the
+        serialization the reference gets from committing the keyResolvers
+        system-keyspace transaction through the pipeline itself."""
+        while True:
+            await self.loop.delay(
+                self.knobs.RESOLUTION_BALANCE_INTERVAL, TaskPriority.COORDINATION
+            )
+            gen = self.generation
+            if gen is None or self._recovering or len(gen.resolvers) < 2:
+                continue
+            try:
+                await self._try_rebalance(gen)
+            except (TimedOut, BrokenPromise):
+                continue  # transient (mid-kill); next tick retries
+
+    async def _try_rebalance(self, gen: GenerationRoles) -> None:
+        cc = self._cc_proc()
+        loads: list[int] = []
+        for r in gen.resolvers:
+            ref = RequestStreamRef(self.net, cc, r.metrics_stream.endpoint)
+            rep = await ref.get_reply(ResolutionMetricsRequest(), timeout=1.0)
+            loads.append(rep.load)
+        total = sum(loads)
+        if total < self.knobs.RESOLUTION_BALANCE_MIN_LOAD:
+            return
+        hi = max(range(len(loads)), key=lambda i: loads[i])
+        others = (total - loads[hi]) / max(len(loads) - 1, 1)
+        if loads[hi] < self.knobs.RESOLUTION_BALANCE_RATIO * max(others, 1.0):
+            return
+        neighbors = [i for i in (hi - 1, hi + 1) if 0 <= i < len(loads)]
+        lo = min(neighbors, key=lambda i: loads[i])
+        if loads[hi] <= loads[lo]:
+            return
+        ref = RequestStreamRef(self.net, cc, gen.resolvers[hi].metrics_stream.endpoint)
+        srep = await ref.get_reply(ResolutionSplitRequest(), timeout=1.0)
+        key = srep.key
+        bounds: list[bytes | None] = [b""] + list(self.resolver_splits) + [None]
+        plo, phi = bounds[hi], bounds[hi + 1]
+        if key is None or key <= plo or (phi is not None and key >= phi):
+            return  # no useful split inside the hot partition
+        new_splits = list(self.resolver_splits)
+        if lo == hi - 1:
+            # left neighbor gains the partition's cold head [plo, key)
+            new_splits[hi - 1] = key
+            moved: tuple[bytes, bytes | None] = (plo, key)
+        else:
+            # right neighbor gains the tail [key, phi)
+            new_splits[hi] = key
+            moved = (key, phi)
+
+        if gen is not self.generation or self._recovering:
+            return
+        for p in gen.proxies:
+            p.pause_commits()
+        try:
+            await self._wait_commit_drain(gen)
+            if gen is not self.generation or self._recovering:
+                # a recovery raced us (possibly mid-_recover, before the
+                # generation swap): its recruit used the old splits, so
+                # committing this move would desync controller.resolver_splits
+                # from the live proxy maps — bail; the next tick re-balances
+                return
+            vm = gen.sequencer._last_assigned + 1
+            gen.resolvers[lo].install_moved_range(moved[0], moved[1], vm)
+            for p in gen.proxies:
+                p.install_resolver_splits(new_splits, vm)
+            self.resolver_splits = new_splits
+            self.resolver_moves += 1
+            self.trace.trace(
+                "ResolverRebalance", From=hi, To=lo, Epoch=self.epoch,
+                SplitKey=repr(key), EffectiveVersion=vm,
+            )
+        finally:
+            for p in gen.proxies:
+                p.resume_commits()
+
+    async def _wait_commit_drain(self, gen: GenerationRoles) -> None:
+        deadline = self.loop.now() + 5.0
+        while any(p.inflight_batches for p in gen.proxies):
+            if self.loop.now() >= deadline:
+                raise TimedOut("commit plane never drained for rebalance")
+            await self.loop.delay(0.005, TaskPriority.COORDINATION)
+
     def _on_proxy_failure(self, proxy, exc) -> None:
         """A proxy exhausted its commit-path retry budget (e.g. a partition
         between proxy and resolver that heartbeats can't see): its assigned
@@ -541,6 +640,8 @@ class ClusterController:
                     )
 
     def stop(self) -> None:
+        if getattr(self, "_balance_task", None) is not None:
+            self._balance_task.cancel()
         if self._monitor_task is not None:
             self._monitor_task.cancel()
         if self.generation is not None:

@@ -105,7 +105,13 @@ class CommitProxy:
         self.knobs = knobs
         self.sequencer = sequencer_ref
         self.resolvers = resolver_refs
-        self.rmap = KeyPartitionMap(resolver_splits, list(range(len(resolver_refs))))
+        # keyResolvers: version-indexed history of resolver partition maps
+        # (MasterProxyServer.actor.cpp:287-299) — a batch at version V splits
+        # its conflict ranges with the map effective at V, so a rebalance
+        # mid-stream never mis-routes an in-flight batch
+        self._rmaps: list[tuple[Version, KeyPartitionMap]] = [
+            (0, KeyPartitionMap(resolver_splits, list(range(len(resolver_refs)))))
+        ]
         self.tlogs = tlog_refs
         self.tags = storage_tags
         # which TLog replicas store each tag (TagPartitionedLogSystem's
@@ -139,6 +145,8 @@ class CommitProxy:
         self.c_throttled = self.counters.counter("mvcc_window_throttles")
         self._pending: list[_PendingCommit] = []
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
+        self._paused = False    # resolutionBalancing drain barrier
+        self._inflight = 0      # commit batches between spawn andcompletion
         self._tasks = [
             loop.spawn(self._accept_commits(), TaskPriority.PROXY_COMMIT, "proxy-accept"),
             loop.spawn(self._batcher(), TaskPriority.PROXY_COMMIT, "proxy-batcher"),
@@ -146,6 +154,36 @@ class CommitProxy:
             loop.spawn(self._raw_version_server(), TaskPriority.GET_LIVE_VERSION,
                        "proxy-raw"),
         ]
+
+    def rmap_at(self, version: Version) -> KeyPartitionMap:
+        """The resolver map effective at `version` (keyResolvers lookup)."""
+        for from_v, m in reversed(self._rmaps):
+            if version >= from_v:
+                return m
+        return self._rmaps[0][1]
+
+    def install_resolver_splits(
+        self, splits: list[bytes], from_version: Version
+    ) -> None:
+        """New partition map effective at `from_version` (installed by the
+        controller during a drained rebalance)."""
+        self._rmaps.append(
+            (from_version, KeyPartitionMap(list(splits), list(range(len(self.resolvers)))))
+        )
+        if len(self._rmaps) > 8:
+            self._rmaps = self._rmaps[-8:]
+
+    def pause_commits(self) -> None:
+        """Hold new commit batches (requests keep queueing in _pending);
+        in-flight batches drain — the rebalance version-boundary barrier."""
+        self._paused = True
+
+    def resume_commits(self) -> None:
+        self._paused = False
+
+    @property
+    def inflight_batches(self) -> int:
+        return self._inflight
 
     # -- phase 1: batching --------------------------------------------------
     async def _accept_commits(self) -> None:
@@ -161,6 +199,8 @@ class CommitProxy:
         idle = 0.0
         while True:
             await self.loop.delay(self._batch_interval, TaskPriority.PROXY_COMMIT)
+            if self._paused:
+                continue
             # adapt the interval to how full this tick's batch is, sampled
             # BEFORE the swap: a fuller pipeline fires batches faster
             full = len(self._pending) / max(self.knobs.COMMIT_BATCH_MAX_COUNT, 1)
@@ -203,6 +243,7 @@ class CommitProxy:
                 )
 
     async def _commit_batch(self, batch: list[_PendingCommit]) -> None:
+        self._inflight += 1
         try:
             await self._commit_batch_inner(batch)
         except Exception as e:  # noqa: BLE001 — containment: ANY commit-path
@@ -219,6 +260,8 @@ class CommitProxy:
                 self.counters.counter("commit_path_failures").add(1)
                 if self.on_commit_failure is not None:
                     self.on_commit_failure(self, e)
+        finally:
+            self._inflight -= 1
 
     async def _commit_batch_inner(self, batch: list[_PendingCommit]) -> None:
         self.c_batches.add(1)
@@ -234,6 +277,8 @@ class CommitProxy:
         prev_v, version = gv.prev_version, gv.version
 
         # phase 2: per-resolver range split (ResolutionRequestBuilder :242)
+        # using the partition map effective at THIS batch's version
+        rmap = self.rmap_at(version)
         n_res = len(self.resolvers)
         per_res: list[list[TxInfo]] = [[] for _ in range(n_res)]
         for pc in batch:
@@ -242,12 +287,12 @@ class CommitProxy:
                 rr = [
                     c
                     for b, e in t.read_conflict_ranges
-                    if (c := self.rmap.clip_to_member(r, b, e))
+                    if (c := rmap.clip_to_member(r, b, e))
                 ]
                 wr = [
                     c
                     for b, e in t.write_conflict_ranges
-                    if (c := self.rmap.clip_to_member(r, b, e))
+                    if (c := rmap.clip_to_member(r, b, e))
                 ]
                 per_res[r].append(TxInfo(t.read_snapshot, rr, wr))
         replies = await wait_all(

@@ -19,6 +19,10 @@ from __future__ import annotations
 from ..conflict.api import ConflictSet, Verdict
 from .sequencer import NotifiedVersion
 from .types import (
+    ResolutionMetricsReply,
+    ResolutionMetricsRequest,
+    ResolutionSplitReply,
+    ResolutionSplitRequest,
     ResolveTransactionBatchReply,
     ResolveTransactionBatchRequest,
     Version,
@@ -33,6 +37,7 @@ from ..runtime.trace import CounterCollection
 
 class Resolver:
     WLT = "wlt:resolver"
+    WLT_METRICS = "wlt:resolver_metrics"
 
     def __init__(
         self,
@@ -55,7 +60,21 @@ class Resolver:
         # version re-receives its real verdicts (the reference caches recent
         # replies; abort-all would turn every retried batch into aborts)
         self._reply_cache: dict[Version, list[int]] = {}
+        # key-load sampling for resolutionBalancing (Resolver.actor.cpp:276):
+        # a windowed conflict-range counter + a bounded reservoir of range
+        # begin keys; the controller turns the median sample into a split
+        self._load_ranges = 0
+        self._samples: list[bytes] = []
+        self._sample_i = 0
+        # ranges moved INTO this resolver mid-generation: before from_version
+        # their history lives on the donor, so any read below it must
+        # conservatively conflict (same family as recovery state-evaporation)
+        self._moved_in: list[tuple[bytes, bytes | None, Version]] = []
+        self.metrics_stream = RequestStream(process, self.WLT_METRICS)
         self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
+        self._metrics_task = loop.spawn(
+            self._serve_metrics(), TaskPriority.RESOLVER, "resolver-metrics"
+        )
 
     async def _serve(self) -> None:
         while True:
@@ -81,7 +100,10 @@ class Resolver:
                 )
             )
             return
+        self._sample_load(r.transactions)
         verdicts = self.cs.resolve_batch(r.version, r.transactions)
+        if self._moved_in:
+            verdicts = self._apply_moved_in_guard(r.transactions, verdicts)
         self.c_batches.add(1)
         self.c_txns.add(len(r.transactions))
         self.c_conflicts.add(sum(1 for v in verdicts if v == Verdict.CONFLICT))
@@ -89,10 +111,12 @@ class Resolver:
         # longer be checked against; raise the TooOld floor
         window = self.knobs.mvcc_window_versions
         if r.version > window:
-            self.cs.remove_before(r.version - window)
+            cutoff = r.version - window
+            self.cs.remove_before(cutoff)
+            # moved-in guards expire once the TooOld floor passes them
+            self._moved_in = [m for m in self._moved_in if m[2] > cutoff]
             # insertion order is version order: evict from the front only,
             # O(evicted) not O(cache size) per batch
-            cutoff = r.version - window
             stale = []
             for v in self._reply_cache:
                 if v >= cutoff:
@@ -107,5 +131,57 @@ class Resolver:
 
     def stop(self) -> None:
         self._task.cancel()
+        self._metrics_task.cancel()
         self.stream.close()
+        self.metrics_stream.close()
         self.cs.close()
+
+    # -- resolutionBalancing support ----------------------------------------
+    def _sample_load(self, txns) -> None:
+        for tx in txns:
+            ranges = list(tx.read_ranges) + list(tx.write_ranges)
+            self._load_ranges += len(ranges)
+            for b, _e in ranges:
+                self._sample_i += 1
+                if self._sample_i % 8 == 0:
+                    self._samples.append(b)
+        if len(self._samples) > 256:
+            self._samples = self._samples[::2]  # deterministic decimation
+
+    def _apply_moved_in_guard(self, txns, verdicts) -> list:
+        out = list(verdicts)
+        for i, tx in enumerate(txns):
+            if out[i] != Verdict.COMMITTED:
+                continue
+            for mb, me, mv in self._moved_in:
+                if tx.read_snapshot < mv and any(
+                    (me is None or b < me) and mb < e
+                    for b, e in tx.read_ranges
+                ):
+                    out[i] = Verdict.CONFLICT
+                    break
+        return out
+
+    def install_moved_range(
+        self, begin: bytes, end: bytes | None, from_version: Version
+    ) -> None:
+        """A key range just moved into this resolver's partition effective
+        at `from_version` (end=None: to the top of key space).  Installed by
+        the controller during a drained rebalance, so no batch straddles it."""
+        self._moved_in.append((begin, end, from_version))
+
+    async def _serve_metrics(self) -> None:
+        while True:
+            req = await self.metrics_stream.next()
+            if isinstance(req.payload, ResolutionMetricsRequest):
+                req.reply(ResolutionMetricsReply(self._load_ranges))
+                self._load_ranges = 0
+            else:
+                assert isinstance(req.payload, ResolutionSplitRequest)
+                s = sorted(self._samples)
+                key = s[len(s) // 2] if len(s) >= 8 else None
+                # reset the reservoir: after the move the old samples skew
+                # toward the donated range and would wedge future splits
+                self._samples = []
+                self._sample_i = 0
+                req.reply(ResolutionSplitReply(key))

@@ -185,6 +185,28 @@ class TLogPeekReply:
 
 
 @dataclasses.dataclass
+class ResolutionMetricsRequest:
+    """How much conflict-range load has this resolver seen since last asked
+    (Resolver.actor.cpp:276 ResolutionMetricsRequest)."""
+
+
+@dataclasses.dataclass
+class ResolutionMetricsReply:
+    load: int  # conflict ranges processed since the previous query
+
+
+@dataclasses.dataclass
+class ResolutionSplitRequest:
+    """Ask the resolver for a key splitting its observed load in half
+    (Resolver.actor.cpp:284 ResolutionSplitRequest)."""
+
+
+@dataclasses.dataclass
+class ResolutionSplitReply:
+    key: bytes | None  # None: not enough samples to split confidently
+
+
+@dataclasses.dataclass
 class TLogPopRequest:
     tag: str
     upto_version: Version

@@ -55,6 +55,11 @@ class CoreKnobs(Knobs):
         self.init("MAX_VERSIONS_IN_FLIGHT", 100_000_000)
         # resolver
         self.init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 30)
+        # resolutionBalancing (masterserver.actor.cpp:964): poll cadence and
+        # the busiest/mean load ratio that triggers a split move
+        self.init("RESOLUTION_BALANCE_INTERVAL", 0.5)
+        self.init("RESOLUTION_BALANCE_RATIO", 2.0)
+        self.init("RESOLUTION_BALANCE_MIN_LOAD", 64)
         self.init("SAMPLE_OFFSET_PER_KEY", 100)
         # storage
         self.init("STORAGE_DURABILITY_LAG", 0.05)
