@@ -15,6 +15,10 @@ abort-set parity.  The stream mimics the reference's skipListTest shape
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
 is device checks/s and vs_baseline is the speedup over the native CPU skip
 list on this host.
+
+Per-phase accounting (the skipListTest PerfCounters analog) lives in
+phase_timings.py; the bench itself autotunes the kernel's search/merge
+implementations on the live device before timing (see _autotune).
 """
 
 from __future__ import annotations
@@ -266,14 +270,78 @@ def main() -> None:
         )
 
 
+def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str]:
+    """Pick the fastest (search_impl, merge_impl) pair ON THIS DEVICE.
+
+    XLA's lowering quality for scatters/gathers vs sorts differs wildly
+    across backends (TPU scatters serialize per row; sorts are tuned
+    networks — and the CPU backend inverts that), so the kernel ships both
+    implementations of its two heavy phases and the bench measures which
+    combination wins before taking the headline number.  Disable with
+    BENCH_AUTOTUNE=0 (then FDBTPU_SEARCH_IMPL/FDBTPU_MERGE_IMPL decide)."""
+    import jax
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    if os.environ.get("BENCH_AUTOTUNE", "1") == "0":
+        from foundationdb_tpu.conflict.device import impl_from_env
+
+        si = impl_from_env("search")
+        mi = impl_from_env("merge")
+        print(f"[bench] autotune off: search={si} merge={mi}", file=sys.stderr)
+        return si, mi
+
+    combos = [("sort", "sort"), ("bucket", "scatter"), ("bucket", "sort")]
+    results = {}
+    for si, mi in combos:
+        try:
+            dev = DeviceConflictSet(
+                max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
+                search_impl=si, merge_impl=mi,
+            )
+            for b in prefill[:2]:
+                dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
+            probes = [
+                (b["version"], jax.device_put(device_pack(pool_words, b, _bucket)))
+                for b in prefill[2:5]
+            ]
+            jax.block_until_ready(probes)
+            # warm/compile on the first probe, time the remaining two
+            dev.resolve_arrays(probes[0][0], *probes[0][1], sync=False)
+            dev.check_pipelined()
+            t0 = time.perf_counter()
+            for v, args in probes[1:]:
+                dev.resolve_arrays(v, *args, sync=False)
+            dev.check_pipelined()  # scalar fetch = completion barrier
+            dt = time.perf_counter() - t0
+            results[(si, mi)] = dt
+            print(
+                f"[bench] autotune search={si:<6} merge={mi:<7}: "
+                f"{dt * 1e3 / 2:.1f} ms/batch",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — a combo failing is data
+            print(f"[bench] autotune {si}/{mi} FAILED: {e!r}", file=sys.stderr)
+    if not results:
+        return "sort", "sort"
+    (si, mi) = min(results, key=results.get)
+    print(f"[bench] autotune winner: search={si} merge={mi}", file=sys.stderr)
+    return si, mi
+
+
 def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
                 total_checks, native_s, native_rate) -> None:
     import jax
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet
 
+    search_impl, merge_impl = _autotune(backend, prefill, timed, pool_words)
+
     # ---------------- device ----------------
-    dev = DeviceConflictSet(max_key_bytes=MAX_KEY_BYTES, capacity=CAP)
+    dev = DeviceConflictSet(
+        max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
+        search_impl=search_impl, merge_impl=merge_impl,
+    )
     for b in prefill:
         dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
     # pre-stage the packed batches on device: in production the resolver

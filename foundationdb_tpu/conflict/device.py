@@ -82,6 +82,25 @@ BUCKET_BITS = 16
 N_BUCKETS = 1 << BUCKET_BITS
 FAST_SEARCH_ITERS = 11  # converges windows up to 1024 boundaries (2**(n-1))
 
+_IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort")}
+
+
+def impl_from_env(kind: str, override: str | None = None) -> str:
+    """Resolve the search/merge implementation choice: explicit override,
+    else FDBTPU_{KIND}_IMPL, else "sort" (the TPU-friendly default — XLA's
+    TPU scatters/gathers lower to serial per-row loops while sorts are tuned
+    networks; bench.py autotunes the final pick on the live device).  A
+    single source of truth so the device, sharded and bench paths cannot
+    drift; unknown values fail loudly."""
+    import os
+
+    v = override or os.environ.get(f"FDBTPU_{kind.upper()}_IMPL", "sort")
+    if v not in _IMPL_CHOICES[kind]:
+        raise ValueError(
+            f"unknown {kind}_impl {v!r}; choose one of {_IMPL_CHOICES[kind]}"
+        )
+    return v
+
 
 def host_bucket_index(ks_rows: np.ndarray) -> np.ndarray:
     """word0-prefix bucket index of sorted boundary rows, host-side (the np
@@ -158,6 +177,48 @@ def phase_search(ks, bucket_idx, count, rb, re_, wb, we, r_ok, w_ok,
     return g_lo, g_hi, wb_rank, we_rank, converged
 
 
+def phase_search_sort(ks, count, rb, re_, wb, we, r_ok, w_ok):
+    """Sort-based twin of phase_search: ranks every query against the state
+    with ONE multi-key sort instead of log-depth row gathers (TPU gathers
+    lower to serial per-row loops; lax.sort is a tuned network).
+
+    lower_bound(q) = #state keys < q = (sorted position of q) - (#queries
+    before q in the sorted order), with queries ordered BEFORE equal state
+    keys (flag 0 vs 1) so equal keys are not counted.  Exact at any depth —
+    no convergence fallback.  Returns (g_lo, g_hi, wb_rank, we_rank,
+    converged=True)."""
+    R, Wn = rb.shape[0], wb.shape[0]
+    W = ks.shape[1]
+    cap = ks.shape[0]
+    rb_plus = rb.at[:, -1].add(1)
+    queries = jnp.concatenate([rb_plus, re_, wb, we], axis=0)
+    nq = queries.shape[0]
+    # sentinel-pad the state past `count` is already true of ks; sentinel
+    # queries (padding rows) rank among the sentinels — discarded by *_ok
+    rows = jnp.concatenate([queries, ks], axis=0)
+    flag = jnp.concatenate([jnp.zeros(nq, jnp.uint32), jnp.ones(cap, jnp.uint32)])
+    idx = jnp.concatenate(
+        [jnp.arange(nq, dtype=jnp.int32), jnp.full(cap, -1, jnp.int32)]
+    )
+    ops = tuple(rows[:, w] for w in range(W)) + (flag, idx)
+    srt = jax.lax.sort(ops, num_keys=W + 1)
+    sidx = srt[W + 1]
+    is_q = sidx >= 0
+    pos = jnp.arange(nq + cap, dtype=jnp.int32)
+    n_q_before = jnp.cumsum(is_q.astype(jnp.int32)) - is_q.astype(jnp.int32)
+    state_rank = pos - n_q_before
+    # clamp into the live prefix (sentinel-region ranks exceed count)
+    state_rank = jnp.minimum(state_rank, count)
+    ranks = jnp.zeros(nq, jnp.int32).at[
+        jnp.where(is_q, sidx, nq)
+    ].set(jnp.where(is_q, state_rank, 0), mode="drop")
+    g_lo = ranks[:R] - 1
+    g_hi = ranks[R : 2 * R]
+    wb_rank = ranks[2 * R : 2 * R + Wn]
+    we_rank = ranks[2 * R + Wn :]
+    return g_lo, g_hi, wb_rank, we_rank, jnp.asarray(True)
+
+
 def phase_history(vs, g_lo, g_hi, snap, r_idx, r_ok, n_txn: int):
     """History conflicts (replaces SkipList::detectConflicts :524):
     range-max of `vs` over each read's covered gaps; conflict iff
@@ -222,6 +283,8 @@ def resolve_core(
     ok_in=True,  # bool scalar: validity accumulated across a pipelined stream
     *, cap: int, n_txn: int, n_read: int, n_write: int,
     search_iters: int = FAST_SEARCH_ITERS,
+    merge_impl: str = "scatter",  # "scatter" | "sort" (phase_merge twin)
+    search_impl: str = "bucket",  # "bucket" | "sort" (phase_search twin)
 ):
     """Pure kernel body — jitted directly for the single-partition path and
     called inside shard_map for the multi-resolver path (parallel/sharded.py).
@@ -239,6 +302,10 @@ def resolve_core(
     ok); `converged` False means a prefix bucket was deeper than 2**search_iters —
     the host replays the same batch with a full-depth search (pure kernel,
     no donation, so replay is exact)."""
+    if merge_impl not in _IMPL_CHOICES["merge"]:
+        raise ValueError(f"unknown merge_impl {merge_impl!r}")
+    if search_impl not in _IMPL_CHOICES["search"]:
+        raise ValueError(f"unknown search_impl {search_impl!r}")
     B = n_txn
     r_ok = r_tx >= 0
     r_idx = jnp.clip(r_tx, 0, B - 1)
@@ -246,9 +313,14 @@ def resolve_core(
     w_idx = jnp.clip(w_tx, 0, B - 1)
 
     # ---- the ONE state search ------------------------------------------
-    g_lo, g_hi, wb_rank, we_rank, converged = phase_search(
-        ks, bucket_idx, count, rb, re_, wb, we, r_ok, w_ok, search_iters
-    )
+    if search_impl == "sort":
+        g_lo, g_hi, wb_rank, we_rank, converged = phase_search_sort(
+            ks, count, rb, re_, wb, we, r_ok, w_ok
+        )
+    else:
+        g_lo, g_hi, wb_rank, we_rank, converged = phase_search(
+            ks, bucket_idx, count, rb, re_, wb, we, r_ok, w_ok, search_iters
+        )
 
     # ---- phase 1: history conflicts ------------------------------------
     hist = phase_history(vs, g_lo, g_hi, snap, r_idx, r_ok, B)
@@ -267,8 +339,14 @@ def resolve_core(
 
     # ---- phase 3: merge committed writes into the step function ---------
     w_ins = w_ok & committed[w_idx]
-    new_ks, new_vs, new_count, new_bucket_idx = phase_merge(
+    merge = phase_merge if merge_impl == "scatter" else phase_merge_sort
+    new_ks, new_vs, new_count = merge(
         ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, cap=cap
+    )
+    # the bucket index feeds only the bucketed search: with the sort search
+    # selected, skip the cap-sized scatter-add rebuild entirely
+    new_bucket_idx = (
+        bucket_idx if search_impl == "sort" else _rebuild_buckets(new_ks)
     )
 
     # validity of THIS batch folded into the stream's accumulator INSIDE the
@@ -278,17 +356,12 @@ def resolve_core(
     return verdict, new_ks, new_vs, new_count, new_bucket_idx, converged, ok
 
 
-def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
-    """Insert committed writes into the step function (replaces
-    mergeWriteConflictRanges :1260): canonicalize the committed writes'
-    union on the write-endpoint slot domain (scatter deltas + cumsum),
-    merge the canonical boundaries into the state by merge-path scatter
-    positions derived from the ONE search's ranks, recompute gap values
-    with a coverage cumsum on the merged domain, and coalesce equal-valued
-    neighbours.  Returns (new_ks, new_vs, new_count, new_bucket_idx)."""
+def _canonical_union(ks, vs, wb, we, wb_rank, we_rank, w_ins, *, cap: int):
+    """Phase 3a: canonicalize the committed writes' union on the
+    write-endpoint slot domain (slots = unique write endpoint keys, in key
+    order).  Returns (u_rows, u_rank, is_beg, is_end, news_mask,
+    resume_val) — shared by both merge implementations."""
     Wn, W = wb.shape
-    # 3a. canonical committed-write union on the write-endpoint slot domain
-    # (slots = unique write endpoint keys, in key order).
     wlr = _local_ranks(jnp.concatenate([wb, we], axis=0))  # [2Wn] slot ids
     s_b, s_e = wlr[:Wn], wlr[Wn:]
     nslots = 2 * Wn
@@ -320,6 +393,91 @@ def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int
     key_exists = jnp.all(ks_at == u_rows, axis=1)
     resume_idx = jnp.clip(jnp.where(key_exists, u_rank, u_rank - 1), 0, cap - 1)
     resume_val = vs[resume_idx]
+    return u_rows, u_rank, is_beg, is_end, news_mask, resume_val
+
+
+def _rebuild_buckets(new_ks):
+    """Phase 3d: word0-prefix bucket index (sentinels land in the last
+    bucket; bucket_idx[h] = lower_bound of prefix h, bucket_idx[-1] = cap)."""
+    h_all = (new_ks[:, 0] >> BUCKET_BITS).astype(jnp.int32)
+    hist_b = jnp.zeros(N_BUCKETS + 1, jnp.int32).at[h_all + 1].add(1)
+    return jnp.cumsum(hist_b)
+
+
+def phase_merge_sort(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
+    """Sort-based insert (the scatter-free twin of phase_merge): TPU scatters
+    and large gathers lower to serial per-row loops (~1us/row — seconds at
+    these shapes), while lax.sort is a tuned bitonic network.  So the merge
+    is TWO sorts instead of five M-sized scatters:
+
+      sort 1  (W key words + a news-first tiebreak): state rows and the
+              canonical new boundaries into one ordered domain; coverage
+              deltas and gap values ride along as payloads, then the same
+              cumsum/coalesce logic as the scatter path runs elementwise.
+      sort 2  (1-bit key, stable): compaction — kept rows to the front,
+              dropped rows (masked to sentinels) to the back, then a STATIC
+              [:cap] slice is the new state.  No scatter anywhere.
+
+    Returns (new_ks, new_vs, new_count)."""
+    Wn, W = wb.shape
+    u_rows, u_rank, is_beg, is_end, news_mask, resume_val = _canonical_union(
+        ks, vs, wb, we, wb_rank, we_rank, w_ins, cap=cap
+    )
+    nslots = 2 * Wn
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
+
+    # ---- sort 1: ordered merge of olds and news ------------------------
+    news_rows = jnp.where(news_mask[:, None], u_rows, sent_row[None, :])
+    rows = jnp.concatenate([news_rows, ks], axis=0)          # [M, W]
+    # news-first on equal keys, so an old boundary's coverage cumsum sees
+    # every equal-key transition (same ordering contract as the merge path)
+    flag = jnp.concatenate(
+        [jnp.zeros(nslots, jnp.uint32), jnp.ones(cap, jnp.uint32)]
+    )
+    mdelta = jnp.concatenate(
+        [
+            jnp.where(news_mask, jnp.where(is_beg, 1, -1), 0).astype(jnp.int32),
+            jnp.zeros(cap, jnp.int32),
+        ]
+    )
+    val_in = jnp.concatenate(
+        [jnp.where(is_beg, commit_off, resume_val).astype(jnp.int32), vs]
+    )
+    ops = tuple(rows[:, w] for w in range(W)) + (flag, mdelta, val_in)
+    srt = jax.lax.sort(ops, num_keys=W + 1)
+    merged = jnp.stack(srt[:W], axis=1)
+    sflag, smdelta, sval = srt[W], srt[W + 1], srt[W + 2]
+    mcov = jnp.cumsum(smdelta) > 0
+    val = jnp.where((sflag == 1) & mcov, commit_off, sval)
+
+    # ---- coalesce + compaction via sort 2 ------------------------------
+    sent = _is_sentinel(merged)
+    keep = ~sent & jnp.concatenate([jnp.array([True]), val[1:] != val[:-1]])
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    rows2 = jnp.where(keep[:, None], merged, sent_row[None, :])
+    val2 = jnp.where(keep, val, 0)
+    ops2 = ((~keep).astype(jnp.uint32),) + tuple(
+        rows2[:, w] for w in range(W)
+    ) + (val2,)
+    srt2 = jax.lax.sort(ops2, num_keys=1, is_stable=True)
+    new_ks = jnp.stack(srt2[1 : 1 + W], axis=1)[:cap]
+    new_vs = srt2[1 + W][:cap]
+    return new_ks, new_vs, new_count
+
+
+def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
+    """Insert committed writes into the step function (replaces
+    mergeWriteConflictRanges :1260): canonicalize the committed writes'
+    union on the write-endpoint slot domain (scatter deltas + cumsum),
+    merge the canonical boundaries into the state by merge-path scatter
+    positions derived from the ONE search's ranks, recompute gap values
+    with a coverage cumsum on the merged domain, and coalesce equal-valued
+    neighbours.  Returns (new_ks, new_vs, new_count, new_bucket_idx)."""
+    Wn, W = wb.shape
+    u_rows, u_rank, is_beg, is_end, news_mask, resume_val = _canonical_union(
+        ks, vs, wb, we, wb_rank, we_rank, w_ins, cap=cap
+    )
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
 
     # 3b. merge-path positions: news sort before equal olds (so an old
     # boundary's coverage cumsum sees every equal-key transition).
@@ -361,18 +519,15 @@ def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int
     pos = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, M)
     new_ks = jnp.full((cap, W), _SENT_WORD, jnp.uint32).at[pos].set(merged, mode="drop")
     new_vs = jnp.zeros(cap, jnp.int32).at[pos].set(val, mode="drop")
-
-    # 3d. rebuild the word0-prefix bucket index (sentinels land in the last
-    # bucket; bucket_idx[h] = lower_bound of prefix h, bucket_idx[-1] = cap)
-    h_all = (new_ks[:, 0] >> BUCKET_BITS).astype(jnp.int32)
-    hist_b = jnp.zeros(N_BUCKETS + 1, jnp.int32).at[h_all + 1].add(1)
-    new_bucket_idx = jnp.cumsum(hist_b)
-    return new_ks, new_vs, new_count, new_bucket_idx
+    return new_ks, new_vs, new_count
 
 
 _resolve_kernel = functools.partial(
     jax.jit,
-    static_argnames=("cap", "n_txn", "n_read", "n_write", "search_iters"),
+    static_argnames=(
+        "cap", "n_txn", "n_read", "n_write", "search_iters", "merge_impl",
+        "search_impl",
+    ),
 )(resolve_core)
 
 
@@ -454,7 +609,11 @@ class DeviceConflictSet(ConflictSet):
         *,
         max_key_bytes: int = keymod.DEFAULT_MAX_KEY_BYTES,
         capacity: int = 1 << 16,
+        merge_impl: str | None = None,   # None: FDBTPU_MERGE_IMPL env or "sort"
+        search_impl: str | None = None,  # None: FDBTPU_SEARCH_IMPL env or "sort"
     ) -> None:
+        self._merge_impl = impl_from_env("merge", merge_impl)
+        self._search_impl = impl_from_env("search", search_impl)
         self._max_key_bytes = max_key_bytes
         self._W = keymod.num_words(max_key_bytes)
         self._base = oldest_version
@@ -581,6 +740,8 @@ class DeviceConflictSet(ConflictSet):
                 snap_p, active_p, commit_off, self._dev_ok,
                 cap=self._cap, n_txn=Bp, n_read=R, n_write=Wn,
                 search_iters=FAST_SEARCH_ITERS,
+                merge_impl=self._merge_impl,
+                search_impl=self._search_impl,
             )
             self._ks, self._vs, self._bidx = new_ks, new_vs, new_bidx
             self._dev_count = new_count
@@ -601,6 +762,8 @@ class DeviceConflictSet(ConflictSet):
                     snap_p, active_p, commit_off,
                     cap=self._cap, n_txn=Bp, n_read=R, n_write=Wn,
                     search_iters=iters,
+                    merge_impl=self._merge_impl,
+                    search_impl=self._search_impl,
                 )
                 if bool(conv):
                     break

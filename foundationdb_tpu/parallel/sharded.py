@@ -43,6 +43,7 @@ from ..conflict.device import (
     _SENT_WORD,
     FAST_SEARCH_ITERS,
     host_bucket_index,
+    impl_from_env,
     pack_batch,
     resolve_core,
 )
@@ -89,7 +90,7 @@ def _sharded_resolve(
     lo, hi,  # per-device partition bounds: [1, W] each
     rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,  # replicated batch
     ok_in,  # replicated bool: validity accumulated across a pipelined stream
-    *, cap, n_txn, n_read, n_write, search_iters,
+    *, cap, n_txn, n_read, n_write, search_iters, merge_impl, search_impl,
 ):
     ks, vs, lo, hi, bidx = ks[0], vs[0], lo[0], hi[0], bidx[0]
     rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
@@ -98,7 +99,8 @@ def _sharded_resolve(
         ks, vs, bidx, cnt[0], rb, re_, r_tx, wb, we, w_tx, snap, active,
         commit_off, ok_in,
         cap=cap, n_txn=n_txn, n_read=n_read, n_write=n_write,
-        search_iters=search_iters,
+        search_iters=search_iters, merge_impl=merge_impl,
+        search_impl=search_impl,
     )
     # proxy min-combine (MasterProxyServer.actor.cpp:558-569) over ICI; the
     # convergence / stream-validity flags fold the same way (all devices must
@@ -118,15 +120,20 @@ def _sharded_gc(vs, off):
 
 
 def build_sharded_resolver(
-    mesh: Mesh, *, cap: int, n_txn: int, n_read: int, n_write: int, search_iters: int
+    mesh: Mesh, *, cap: int, n_txn: int, n_read: int, n_write: int,
+    search_iters: int, merge_impl: str | None = None,
+    search_impl: str | None = None,
 ):
     """Jit-compiled sharded resolve step for fixed bucket sizes."""
+    merge_impl = impl_from_env("merge", merge_impl)
+    search_impl = impl_from_env("search", search_impl)
     shard = P(RESOLVER_AXIS)
     repl = P()
     fn = jax.shard_map(
         functools.partial(
             _sharded_resolve, cap=cap, n_txn=n_txn, n_read=n_read,
-            n_write=n_write, search_iters=search_iters,
+            n_write=n_write, search_iters=search_iters, merge_impl=merge_impl,
+            search_impl=search_impl,
         ),
         mesh=mesh,
         in_specs=(shard, shard, shard, shard, shard, shard) + (repl,) * 10,
@@ -156,7 +163,11 @@ class ShardedDeviceConflictSet(ConflictSet):
         *,
         max_key_bytes: int = keymod.DEFAULT_MAX_KEY_BYTES,
         capacity: int = 1 << 14,
+        merge_impl: str | None = None,
+        search_impl: str | None = None,
     ) -> None:
+        self._merge_impl = impl_from_env("merge", merge_impl)
+        self._search_impl = impl_from_env("search", search_impl)
         n = mesh.devices.size
         if len(split_keys) != n - 1:
             raise ValueError(f"need {n - 1} split keys for {n} resolver devices")
@@ -225,11 +236,15 @@ class ShardedDeviceConflictSet(ConflictSet):
         return max(off, 0)
 
     def _fn(self, n_txn: int, n_read: int, n_write: int, search_iters: int):
-        key = (self._cap, n_txn, n_read, n_write, search_iters)
+        key = (
+            self._cap, n_txn, n_read, n_write, search_iters,
+            self._merge_impl, self._search_impl,
+        )
         if key not in self._fns:
             self._fns[key] = build_sharded_resolver(
                 self._mesh, cap=self._cap, n_txn=n_txn, n_read=n_read,
                 n_write=n_write, search_iters=search_iters,
+                merge_impl=self._merge_impl, search_impl=self._search_impl,
             )
         return self._fns[key]
 

@@ -156,7 +156,9 @@ def test_shared_prefix_search_fallback():
     Verdicts must still match the oracle exactly."""
     from foundationdb_tpu.conflict.device import DeviceConflictSet
 
-    dev = DeviceConflictSet(capacity=1 << 14)
+    # the bucketed search is the impl with the depth fallback; the sort
+    # search is exact at any depth and never needs one
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
     ref = OracleConflictSet()
 
     # 3000 distinct point writes, all sharing the 2-byte prefix ZZ: their
@@ -197,7 +199,7 @@ def test_pipelined_deferred_failure_replays_through_sync():
               TxInfo(5, [(b"ZZ0001", b"ZZ2999")], [])]),
     ]
 
-    dev = DeviceConflictSet(capacity=1 << 14)
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
     for v, txns in stream:
         packed = pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)
         dev.resolve_arrays(v, *packed[:-1], sync=False)
@@ -205,7 +207,7 @@ def test_pipelined_deferred_failure_replays_through_sync():
         dev.check_pipelined()
 
     # recovery: replay the stream sync on a fresh set; parity vs oracle
-    fresh = DeviceConflictSet(capacity=1 << 14)
+    fresh = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
     ref = OracleConflictSet()
     for v, txns in stream:
         assert fresh.resolve_batch(v, txns) == ref.resolve_batch(v, txns)
@@ -220,7 +222,7 @@ def test_regrow_preserves_pending_pipelined_failure():
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet, pack_batch
 
-    dev = DeviceConflictSet(capacity=1 << 14)
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
 
     def packed(txns):
         return pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)[:-1]
@@ -239,3 +241,48 @@ def test_regrow_preserves_pending_pipelined_failure():
     assert dev.capacity > (1 << 14), "test setup: regrow never happened"
     with pytest.raises(RuntimeError, match="deferred"):
         dev.check_pipelined()
+
+
+def test_merge_impl_parity_scatter_vs_sort():
+    """The scatter and sort merge implementations must produce identical
+    verdict streams AND identical post-merge state (count + probing reads)
+    on a randomized workload including range writes and GC."""
+    import random
+
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    rng = random.Random(77)
+
+    def rand_key():
+        return bytes(rng.randrange(6) for _ in range(rng.randrange(1, 8)))
+
+    def rand_range():
+        a, b = rand_key(), rand_key()
+        if a == b:
+            b = a + b"\x00"
+        return (min(a, b), max(a, b))
+
+    a = DeviceConflictSet(capacity=1 << 10, merge_impl="scatter")
+    b = DeviceConflictSet(capacity=1 << 10, merge_impl="sort")
+    v = 0
+    for i in range(15):
+        v += rng.randrange(3, 30)
+        txns = [
+            TxInfo(
+                max(v - rng.randrange(1, 50), 0),
+                [rand_range() for _ in range(rng.randrange(0, 3))],
+                [rand_range() for _ in range(rng.randrange(0, 3))],
+            )
+            for _ in range(rng.randrange(1, 12))
+        ]
+        va = a.resolve_batch(v, txns)
+        vb = b.resolve_batch(v, txns)
+        assert va == vb, f"batch {i}: verdict divergence {va} vs {vb}"
+        assert a.boundary_count == b.boundary_count, f"batch {i}: state count drift"
+        if i == 8:
+            a.remove_before(v - 20)
+            b.remove_before(v - 20)
+    import numpy as np
+
+    assert np.array_equal(np.asarray(a._ks), np.asarray(b._ks))
+    assert np.array_equal(np.asarray(a._vs), np.asarray(b._vs))
