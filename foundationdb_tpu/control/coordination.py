@@ -75,6 +75,7 @@ class Coordinator:
         self.value: Any = None
         self.write_gen: Generation = GEN_ZERO
         self.promised: Generation = GEN_ZERO
+        self._persists = 0
         self._file = None
         if fs is not None:
             self._file = fs.open(path or f"coord-{process.name}.reg", process)
@@ -104,10 +105,15 @@ class Coordinator:
 
         from ..storage.diskqueue import DiskQueue
 
-        # append-only (recover() takes the last record): truncating in place
-        # would open a crash window with no durable register at all.  The
-        # file grows only with recoveries/elections — bounded in practice.
+        # append-only (recover() takes the last record); every ~64 writes
+        # the log is compacted to one record via the JOURNALED truncate
+        # (diskqueue.rewrite keeps the old synced contents recoverable until
+        # the replacement syncs), so read-promise churn can't grow the file
+        # unboundedly
+        self._persists += 1
         dq = DiskQueue(self._file)
+        if self._persists % 64 == 0:
+            dq.rewrite([])
         dq.push(
             json.dumps(
                 {

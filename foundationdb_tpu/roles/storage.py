@@ -89,24 +89,64 @@ class VersionedOverlay:
 
     def __init__(self) -> None:
         self._chains: dict[bytes, list[tuple[Version, object]]] = {}
+        self._chain_keys: list[bytes] = []  # sorted index over _chains
         self._clears: list[tuple[Version, bytes, bytes]] = []  # (v, begin, end)
+        # begin-sorted clear view + prefix max-end, for O(log n + overlap)
+        # point stabs instead of a full-list scan per base-miss read
+        self._stab_dirty = False
+        self._stab: list[tuple[bytes, bytes, Version]] = []
+        self._stab_begins: list[bytes] = []
+        self._stab_maxend: list[bytes] = []
         self.oldest = 0  # oldest readable version retained
+
+    def _chain_for(self, key: bytes) -> list:
+        chain = self._chains.get(key)
+        if chain is None:
+            chain = self._chains[key] = []
+            bisect.insort(self._chain_keys, key)
+        return chain
+
+    def _rebuild_stab(self) -> None:
+        self._stab = sorted((b, e, v) for v, b, e in self._clears)
+        self._stab_begins = [b for b, _e, _v in self._stab]
+        self._stab_maxend = []
+        m = b""
+        for _b, e, _v in self._stab:
+            m = max(m, e)
+            self._stab_maxend.append(m)
+        self._stab_dirty = False
 
     def apply(self, version: Version, m: Mutation, base_get) -> None:
         if m.type == MutationType.SET_VALUE:
-            self._chains.setdefault(m.key, []).append((version, m.value))
+            self._chain_for(m.key).append((version, m.value))
         elif m.type == MutationType.CLEAR_RANGE:
             self._clears.append((version, m.key, m.value))
-            for k in list(self._chains):
-                if m.key <= k < m.value:
-                    self._chains[k].append((version, _CLEARED))
+            self._stab_dirty = True
+            # touch only the chains inside the range (sorted index bisect),
+            # not every chain in the overlay
+            lo = bisect.bisect_left(self._chain_keys, m.key)
+            hi = bisect.bisect_left(self._chain_keys, m.value)
+            for k in self._chain_keys[lo:hi]:
+                self._chains[k].append((version, _CLEARED))
         else:  # atomic op: fold with the current visible value
             old = self.get(m.key, version, base_get)
             new = apply_atomic(m.type, old, m.value)
-            self._chains.setdefault(m.key, []).append((version, new))
+            self._chain_for(m.key).append((version, new))
 
     def _cleared_after_base(self, key: bytes, version: Version) -> bool:
-        return any(v <= version and b <= key < e for v, b, e in self._clears)
+        if not self._clears:
+            return False
+        if self._stab_dirty:
+            self._rebuild_stab()
+        # candidates have begin <= key; prune the walk once no remaining
+        # prefix can reach past `key`
+        i = bisect.bisect_right(self._stab_begins, key) - 1
+        while i >= 0 and self._stab_maxend[i] > key:
+            b, e, v = self._stab[i]
+            if e > key and v <= version:
+                return True
+            i -= 1
+        return False
 
     def get(self, key: bytes, version: Version, base_get) -> bytes | None:
         chain = self._chains.get(key)
@@ -119,7 +159,9 @@ class VersionedOverlay:
         return base_get(key)
 
     def overlay_keys_in(self, begin: bytes, end: bytes) -> Iterable[bytes]:
-        return (k for k in self._chains if begin <= k < end)
+        lo = bisect.bisect_left(self._chain_keys, begin)
+        hi = bisect.bisect_left(self._chain_keys, end)
+        return self._chain_keys[lo:hi]
 
     def forget_before(self, version: Version, base_set, base_clear) -> None:
         """Flush entries <= version into the base and drop old history.
@@ -135,6 +177,7 @@ class VersionedOverlay:
             if cv <= version:
                 base_clear(b, e)
         self._clears = [c for c in self._clears if c[0] > version]
+        self._stab_dirty = True
         self._flush_chains(version, base_set, base_clear)
         self.oldest = max(self.oldest, version)
 
@@ -152,6 +195,7 @@ class VersionedOverlay:
                     self._chains[key] = remaining
                 else:
                     del self._chains[key]
+        self._chain_keys = sorted(self._chains)
 
     def rollback_to(self, version: Version) -> None:
         """Discard every entry/clear with version > version (recovery: a
@@ -165,7 +209,9 @@ class VersionedOverlay:
                 self._chains[key] = kept
             else:
                 del self._chains[key]
+        self._chain_keys = sorted(self._chains)
         self._clears = [c for c in self._clears if c[0] <= version]
+        self._stab_dirty = True
 
 
 class StorageServer:
