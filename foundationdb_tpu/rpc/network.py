@@ -50,19 +50,17 @@ class Endpoint:
     token: str
 
 
-class SimProcess:
-    """A simulated process: endpoint table + lifecycle (ISimulator::ProcessInfo)."""
+class EndpointTable:
+    """Token -> handler table shared by the simulated and real process
+    objects (the FlowTransport endpoint map).  Delivery to a dead process or
+    an unknown token is dropped, like the reference's unknown-endpoint path."""
 
-    def __init__(self, net: "SimNetwork", address: NetworkAddress, name: str) -> None:
-        self.net = net
+    def __init__(self, address: NetworkAddress, name: str) -> None:
         self.address = address
         self.name = name
         self.alive = True
-        self.reboots = 0
         self._endpoints: dict[str, Callable[[Any], None]] = {}
-        self.on_death: list[Promise] = []
 
-    # -- endpoints ---------------------------------------------------------
     def register(self, token: str, handler: Callable[[Any], None]) -> Endpoint:
         self._endpoints[token] = handler
         return Endpoint(self.address, token)
@@ -70,17 +68,25 @@ class SimProcess:
     def unregister(self, token: str) -> None:
         self._endpoints.pop(token, None)
 
-    def new_token(self) -> str:
-        return self.net.rng.random_unique_id()
-
-    # -- lifecycle ---------------------------------------------------------
     def _deliver(self, token: str, payload: Any) -> None:
         if not self.alive:
             return
         handler = self._endpoints.get(token)
         if handler is not None:
             handler(payload)
-        # unknown token: dropped, like the reference's unknown-endpoint path
+
+
+class SimProcess(EndpointTable):
+    """A simulated process: endpoint table + lifecycle (ISimulator::ProcessInfo)."""
+
+    def __init__(self, net: "SimNetwork", address: NetworkAddress, name: str) -> None:
+        super().__init__(address, name)
+        self.net = net
+        self.reboots = 0
+        self.on_death: list[Promise] = []
+
+    def new_token(self) -> str:
+        return self.net.rng.random_unique_id()
 
     def kill(self) -> None:
         """Hard kill: endpoints vanish, in-flight replies break."""

@@ -1,0 +1,350 @@
+"""Real TCP transport — the production twin of the simulated fabric
+(fdbrpc/FlowTransport.actor.cpp:48-581 + flow/Net2.actor.cpp's reactor).
+
+The INetwork seam contract (rpc/network.py): roles and the typed RPC layer
+(rpc/stream.py) see only `net.send(src, endpoint, payload)` and a process
+endpoint table.  This module implements that contract over non-blocking
+sockets, so the SAME RequestStream/ReplyPromise code runs across OS
+processes:
+
+  * one `RealNetwork` per OS process, listening on one (ip, port) — its
+    `RealProcess` is the local endpoint table (the FlowTransport singleton)
+  * persistent length-prefixed connections per peer, dialed on first send
+    and reused both ways (the reference keeps one Peer per address)
+  * frames carry (dst_token, payload); payloads are pickled role messages
+    (the reference uses flatbuffers-style object serialization; the wire
+    discipline — framing, peer reuse, connection-failure => broken_promise —
+    is what this layer owes the stack, and runtime/serialize.py remains the
+    explicit codec for durable state)
+  * a dead/unreachable peer fails requests fast with BrokenPromise, exactly
+    like the simulated fabric's connection-reset analog, so client retry
+    behavior is identical in both worlds
+  * `NetDriver` pumps the selector inside the event loop's idle gaps —
+    the Net2 "reactor + run loop" shape
+
+Demo/tests: tests/test_transport.py runs request/reply and a mini KV
+service across real OS processes.
+"""
+
+from __future__ import annotations
+
+import pickle
+import selectors
+import socket
+import struct
+import time as _time
+from typing import Any, Callable
+
+from ..runtime.core import BrokenPromise, EventLoop, Future, TaskPriority, TimedOut
+from .network import Endpoint, EndpointTable, NetworkAddress
+from .stream import RpcError
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 << 20
+
+
+class _Conn:
+    """One peer connection: framed, buffered, non-blocking."""
+
+    def __init__(self, sock: socket.socket, addr: NetworkAddress | None) -> None:
+        self.sock = sock
+        self.addr = addr  # peer's LISTENING address (None until hello)
+        self.out = bytearray()
+        self.inbuf = bytearray()
+        self.connecting = False
+        self.dead = False
+        # reply tokens of requests sent over this connection and not yet
+        # answered: failed with BrokenPromise if the connection dies (the
+        # reference fails a Peer's outstanding replies on disconnect)
+        self.pending: set[str] = set()
+
+    def queue_frame(self, blob: bytes) -> None:
+        self.out += _LEN.pack(len(blob)) + blob
+
+    def frames(self):
+        """Yield complete frames out of inbuf."""
+        pos = 0
+        n = len(self.inbuf)
+        while pos + _LEN.size <= n:
+            (ln,) = _LEN.unpack_from(self.inbuf, pos)
+            if ln > MAX_FRAME:
+                raise ConnectionError("oversized frame")
+            if pos + _LEN.size + ln > n:
+                break
+            yield bytes(self.inbuf[pos + _LEN.size : pos + _LEN.size + ln])
+            pos += _LEN.size + ln
+        del self.inbuf[:pos]
+
+
+class RealProcess(EndpointTable):
+    """Endpoint table + lifecycle, shape-compatible with SimProcess."""
+
+    def __init__(self, net: "RealNetwork", address: NetworkAddress, name: str) -> None:
+        super().__init__(address, name)
+        self.net = net
+        self._token_seq = 0
+
+    def new_token(self) -> str:
+        self._token_seq += 1
+        return f"{self.name}-{self._token_seq}"
+
+
+class RealNetwork:
+    """TCP INetwork: one per OS process.  Surface-compatible with the slice
+    of SimNetwork that rpc/stream.py and the roles actually use.
+
+    TRUST BOUNDARY: frames are pickled Python objects — deserializing gives
+    a peer code execution, so this transport is for loopback or a trusted,
+    isolated cluster network ONLY (the reference's cleartext FlowTransport
+    makes the same assumption; its TLS layer is the production answer).
+    The default bind is 127.0.0.1; binding wider is an explicit opt-in."""
+
+    def __init__(self, loop: EventLoop, name: str = "proc",
+                 ip: str = "127.0.0.1", port: int = 0) -> None:
+        self.loop = loop
+        self._sel = selectors.DefaultSelector()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((ip, port))
+        self._listener.listen(64)
+        self._listener.setblocking(False)
+        self.address = NetworkAddress(ip, self._listener.getsockname()[1])
+        self.process = RealProcess(self, self.address, name)
+        self._conns: dict[NetworkAddress, _Conn] = {}
+        self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
+        self.messages_sent = 0
+        self.messages_dropped = 0
+
+    # -- SimNetwork-compatible sending --------------------------------------
+    def create_process(self, name: str) -> RealProcess:
+        """The real world has ONE process per network (the OS process); the
+        seam's create_process simply hands that out so role constructors and
+        client factories work unchanged."""
+        return self.process
+
+    def send(self, src: NetworkAddress, endpoint: Endpoint, payload: Any) -> None:
+        self.messages_sent += 1
+        if endpoint.address == self.address:
+            # loopback: round-trip through pickle so co-located roles get
+            # the same serialization-boundary isolation as remote peers
+            # (SimNetwork deep-copies at send for exactly this reason)
+            blob = pickle.dumps(payload, protocol=4)
+            self.loop._at(
+                self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
+                lambda: self.process._deliver(endpoint.token, pickle.loads(blob)),
+            )
+            return
+        try:
+            conn = self._conn_to(endpoint.address)
+        except OSError:
+            self.messages_dropped += 1
+            self._break_reply(payload)
+            return
+        reply_to = getattr(payload, "reply_to", None)
+        if reply_to is not None and reply_to.address == self.address:
+            conn.pending.add(reply_to.token)
+        conn.queue_frame(
+            pickle.dumps((endpoint.token, self.address, payload), protocol=4)
+        )
+        self._try_flush(conn)
+
+    def _break_reply(self, msg: Any) -> None:
+        """Connection refused/reset before delivery: fail the caller fast
+        (the same broken_promise contract as the simulated fabric)."""
+        reply_to = getattr(msg, "reply_to", None)
+        if reply_to is None:
+            return
+        self.loop._at(
+            self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
+            lambda: self.process._deliver(
+                reply_to.token, RpcError(BrokenPromise("connection failed"))
+            )
+            if reply_to.address == self.address
+            else None,
+        )
+
+    def _conn_to(self, addr: NetworkAddress) -> _Conn:
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.dead:
+            return conn
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        conn = _Conn(s, addr)
+        conn.connecting = True
+        try:
+            s.connect((addr.ip, addr.port))
+        except BlockingIOError:
+            pass
+        except OSError:
+            s.close()
+            raise
+        self._conns[addr] = conn
+        self._sel.register(
+            s, selectors.EVENT_READ | selectors.EVENT_WRITE, ("conn", conn)
+        )
+        # identify our listening address so the peer can reuse this
+        # connection for traffic back to us (FlowTransport's connect packet)
+        conn.queue_frame(
+            pickle.dumps(("__hello__", self.address, None), protocol=4)
+        )
+        return conn
+
+    # -- reactor -------------------------------------------------------------
+    def pump(self, timeout: float) -> None:
+        """Process socket readiness for up to `timeout` seconds (one poll)."""
+        for key, events in self._sel.select(timeout):
+            kind, conn = key.data
+            if kind == "accept":
+                try:
+                    s, _peer = self._listener.accept()
+                except OSError:
+                    continue
+                s.setblocking(False)
+                c = _Conn(s, None)
+                self._sel.register(
+                    s, selectors.EVENT_READ, ("conn", c)
+                )
+                continue
+            if events & selectors.EVENT_WRITE:
+                conn.connecting = False
+                self._try_flush(conn)
+                if not conn.out:
+                    self._sel.modify(conn.sock, selectors.EVENT_READ, ("conn", conn))
+            if events & selectors.EVENT_READ:
+                self._read(conn)
+
+    def _try_flush(self, conn: _Conn) -> None:
+        if conn.connecting or conn.dead:
+            return
+        try:
+            while conn.out:
+                n = conn.sock.send(conn.out)
+                del conn.out[:n]
+        except BlockingIOError:
+            self._sel.modify(
+                conn.sock,
+                selectors.EVENT_READ | selectors.EVENT_WRITE,
+                ("conn", conn),
+            )
+        except OSError:
+            self._drop_conn(conn)
+
+    def _read(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except BlockingIOError:
+            return
+        except OSError:
+            self._drop_conn(conn)
+            return
+        if not data:
+            self._drop_conn(conn)
+            return
+        conn.inbuf += data
+        try:
+            frames = list(conn.frames())
+            decoded = [pickle.loads(b) for b in frames]
+        except Exception:  # noqa: BLE001 — corrupt peer: sever, don't die
+            self._drop_conn(conn)
+            return
+        for token, peer_addr, payload in decoded:
+            if token == "__hello__":
+                conn.addr = peer_addr
+                # reuse this connection for outbound traffic to the peer
+                if peer_addr not in self._conns or self._conns[peer_addr].dead:
+                    self._conns[peer_addr] = conn
+                continue
+            conn.pending.discard(token)
+            self.loop._at(
+                self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
+                lambda t=token, p=payload: self.process._deliver(t, p),
+            )
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        conn.dead = True
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.addr is not None and self._conns.get(conn.addr) is conn:
+            del self._conns[conn.addr]
+        # fail every request still waiting on this peer — fast, like the
+        # simulated fabric's connection-reset analog
+        pending, conn.pending = conn.pending, set()
+        for token in pending:
+            self.loop._at(
+                self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
+                lambda t=token: self.process._deliver(
+                    t, RpcError(BrokenPromise("connection reset"))
+                ),
+            )
+
+    def close(self) -> None:
+        # sever every registered socket (including accepted-but-unhelloed
+        # peers that never made it into _conns), then the selector itself
+        for key in list(self._sel.get_map().values()):
+            kind, conn = key.data
+            if kind == "conn":
+                self._drop_conn(conn)
+        try:
+            self._sel.unregister(self._listener)
+        except (KeyError, ValueError):
+            pass
+        self._listener.close()
+        self._sel.close()
+
+
+class NetDriver:
+    """Drives an EventLoop against the wall clock WITH socket IO — the Net2
+    reactor: each idle gap until the next timer is spent in select()."""
+
+    def __init__(self, loop: EventLoop, net: RealNetwork) -> None:
+        self.loop = loop
+        self.net = net
+        self._origin = _time.monotonic() - loop.now()
+
+    def run_until(self, fut: Future, wall_timeout: float | None = None) -> Any:
+        start = _time.monotonic()
+        while not fut.done():
+            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:
+                raise TimedOut(f"wall timeout {wall_timeout}s")
+            if self.loop._heap:
+                due = self.loop._heap[0][0]
+                delta = (self._origin + due) - _time.monotonic()
+                if delta > 0:
+                    self.net.pump(min(delta, 0.02))
+                else:
+                    # drain everything currently due, then one poll
+                    while (
+                        self.loop._heap
+                        and self._origin + self.loop._heap[0][0]
+                        <= _time.monotonic()
+                    ):
+                        self.loop.run_one()
+                    self.net.pump(0)
+            else:
+                self.net.pump(0.02)
+            # anchor virtual time to the wall so new timers land correctly
+            # (run_one never moves time backwards, so this is always safe)
+            self.loop._now = max(
+                self.loop._now, _time.monotonic() - self._origin
+            )
+        return fut.result()
+
+    def serve_forever(self, wall_timeout: float | None = None) -> None:
+        """Pump IO + timers until the deadline (server main loop)."""
+        start = _time.monotonic()
+        while wall_timeout is None or _time.monotonic() - start < wall_timeout:
+            self.net.pump(0.02)
+            while self.loop._heap:
+                due = self.loop._heap[0][0]
+                if self._origin + due > _time.monotonic():
+                    break
+                self.loop.run_one()
+            self.loop._now = max(
+                self.loop._now, _time.monotonic() - self._origin
+            )

@@ -1,0 +1,137 @@
+"""Real TCP transport: the typed RPC layer over OS processes.
+
+The same RequestStream/ReplyPromise code that runs on the simulated fabric
+runs here over sockets (the Net2/FlowTransport production twin of the
+seam).  Tests spawn genuine child processes.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from foundationdb_tpu.rpc.stream import RequestStream, RequestStreamRef
+from foundationdb_tpu.rpc.transport import NetDriver, RealNetwork
+from foundationdb_tpu.runtime.core import BrokenPromise, EventLoop
+
+SERVER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    from foundationdb_tpu.rpc.stream import RequestStream
+    from foundationdb_tpu.rpc.transport import NetDriver, RealNetwork
+    from foundationdb_tpu.runtime.core import EventLoop
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="server")
+    rs = RequestStream(net.process, "wlt:echo")
+    kv = {{}}
+    kvs = RequestStream(net.process, "wlt:kv")
+
+    async def serve_echo():
+        while True:
+            req = await rs.next()
+            req.reply(("echoed", req.payload))
+
+    async def serve_kv():
+        while True:
+            req = await kvs.next()
+            op, k, v = req.payload
+            if op == "set":
+                kv[k] = v
+                req.reply(("ok", None))
+            else:
+                req.reply(("ok", kv.get(k)))
+
+    loop.spawn(serve_echo())
+    loop.spawn(serve_kv())
+    print(net.address.port, flush=True)
+    NetDriver(loop, net).serve_forever(wall_timeout=30.0)
+    """
+)
+
+
+@pytest.fixture()
+def server():
+    import foundationdb_tpu
+
+    repo = str(__import__("pathlib").Path(foundationdb_tpu.__file__).parent.parent)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SERVER.format(repo=repo)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"},
+    )
+    port = int(proc.stdout.readline())
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+def test_cross_process_request_reply(server):
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client")
+    drv = NetDriver(loop, net)
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", server), "wlt:echo")
+    )
+    out = drv.run_until(ref.get_reply({"n": 42}, timeout=5.0), wall_timeout=10.0)
+    assert out == ("echoed", {"n": 42})
+    net.close()
+
+
+def test_cross_process_kv_roundtrip(server):
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client")
+    drv = NetDriver(loop, net)
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", server), "wlt:kv")
+    )
+
+    async def main():
+        for i in range(20):
+            st, _ = await ref.get_reply(("set", b"k%d" % i, b"v%d" % i), timeout=5.0)
+            assert st == "ok"
+        vals = []
+        for i in range(20):
+            _, v = await ref.get_reply(("get", b"k%d" % i, None), timeout=5.0)
+            vals.append(v)
+        return vals
+
+    vals = drv.run_until(loop.spawn(main()), wall_timeout=20.0)
+    assert vals == [b"v%d" % i for i in range(20)]
+    net.close()
+
+
+def test_dead_peer_fails_fast():
+    """Connecting to a port nobody listens on must surface BrokenPromise
+    (connection refused), not burn the full timeout — the same contract as
+    the simulated fabric."""
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client")
+    drv = NetDriver(loop, net)
+    # grab a port and close it so nothing listens there
+    import socket as _s
+
+    probe = _s.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", dead_port), "wlt:echo")
+    )
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(BrokenPromise):
+        drv.run_until(ref.get_reply("x", timeout=5.0), wall_timeout=10.0)
+    assert time.monotonic() - t0 < 3.0, "refusal should beat the timeout"
+    net.close()
