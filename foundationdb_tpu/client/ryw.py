@@ -78,8 +78,27 @@ class ReadYourWritesTransaction:
 
     async def get_range(self, begin: bytes, end: bytes, limit: int = 10000,
                         snapshot: bool = False) -> list[tuple[bytes, bytes]]:
-        data = await self._tr.get_range(begin, end, limit=limit, snapshot=snapshot)
-        return self._wm.overlay_range(data, begin, end, limit)
+        """Merged range read.  Buffered clears can remove snapshot rows and
+        buffered sets can add them, so a single limited snapshot fetch may
+        under-fill (or gap) the merged window: keep fetching snapshot chunks
+        and merging only within the COVERED prefix until the limit is met or
+        the snapshot is exhausted (the reference's RYWIterator walks the
+        write map and snapshot in lockstep for the same reason)."""
+        out: list[tuple[bytes, bytes]] = []
+        cursor = begin
+        while len(out) < limit and cursor < end:
+            data = await self._tr.get_range(
+                cursor, end, limit=limit, snapshot=snapshot
+            )
+            exhausted = len(data) < limit
+            covered_end = end if exhausted else key_after(data[-1][0])
+            out.extend(
+                self._wm.overlay_range(data, cursor, covered_end, limit - len(out))
+            )
+            if exhausted:
+                break
+            cursor = covered_end
+        return out[:limit]
 
     # -- writes (buffered in both layers) ------------------------------------
     def set(self, key: bytes, value: bytes) -> None:
@@ -115,6 +134,16 @@ class ReadYourWritesTransaction:
 
     async def commit(self):
         return await self._tr.commit()
+
+    async def on_error(self, e: BaseException) -> None:
+        """Retry protocol (tr.onError): delegate backoff/fence to the inner
+        transaction and drop the write map for the fresh attempt."""
+        await self._tr.on_error(e)
+        self._wm = WriteMap()
+
+    def reset(self) -> None:
+        self._tr.reset()
+        self._wm = WriteMap()
 
     @property
     def committed_version(self):

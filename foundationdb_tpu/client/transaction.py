@@ -111,6 +111,13 @@ class Database:
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
+    def create_ryw_transaction(self):
+        """A read-your-writes transaction (the reference's default client
+        surface, fdbclient/ReadYourWrites.actor.cpp)."""
+        from .ryw import ReadYourWritesTransaction
+
+        return ReadYourWritesTransaction(self)
+
     async def watch(self, key: bytes):
         """Future resolving when `key`'s value changes from its current
         value (fdbclient watch semantics: register against the storage
@@ -135,7 +142,7 @@ class Database:
 
         return self.loop.spawn(waiter())
 
-    async def run(self, fn, max_retries: int = 50):
+    async def run(self, fn, max_retries: int = 50, ryw: bool = True):
         """Retry loop (fdb.transactional): run fn(tr), commit; on retryable
         errors `tr.on_error` backs off — and for CommitUnknownResult first
         fences the in-flight original with the dummy-transaction dance
@@ -145,8 +152,11 @@ class Database:
         The fence only prevents the zombie-commit race (the original landing
         AFTER the retry's reads); a CommitUnknownResult retry can still
         re-apply fn if the original committed — safe only for idempotent or
-        self-verifying transactions, the same contract as the reference."""
-        tr = self.create_transaction()
+        self-verifying transactions, the same contract as the reference.
+
+        Transactions are read-your-writes by default (the reference's client
+        surface); pass ryw=False for the raw snapshot-read flavor."""
+        tr = self.create_ryw_transaction() if ryw else self.create_transaction()
         for _attempt in range(max_retries):
             try:
                 result = await fn(tr)

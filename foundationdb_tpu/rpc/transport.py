@@ -257,7 +257,20 @@ class RealNetwork:
             conn.pending.discard(token)
             self.loop._at(
                 self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
-                lambda t=token, p=payload: self.process._deliver(t, p),
+                lambda t=token, p=payload: self._deliver_or_bounce(t, p),
+            )
+
+    def _deliver_or_bounce(self, token: str, payload: Any) -> None:
+        """Deliver; a request for a closed/unknown stream bounces
+        BrokenPromise to the caller — the same fast-fail the simulated
+        fabric gives, so retry behavior matches across the seam."""
+        if token in self.process._endpoints:
+            self.process._deliver(token, payload)
+            return
+        reply_to = getattr(payload, "reply_to", None)
+        if reply_to is not None:
+            self.send(
+                self.address, reply_to, RpcError(BrokenPromise("endpoint gone"))
             )
 
     def _drop_conn(self, conn: _Conn) -> None:
@@ -307,44 +320,32 @@ class NetDriver:
         self.net = net
         self._origin = _time.monotonic() - loop.now()
 
+    def _tick(self) -> None:
+        """One reactor turn: drain every due timer, poll the sockets for
+        the gap until the next one, and anchor virtual time to the wall
+        (run_one never moves time backwards, so the anchor is always safe —
+        the single place this time model lives for the real-IO driver)."""
+        now = _time.monotonic()
+        while self.loop._heap and self._origin + self.loop._heap[0][0] <= now:
+            self.loop.run_one()
+            now = _time.monotonic()
+        if self.loop._heap:
+            delta = (self._origin + self.loop._heap[0][0]) - now
+            self.net.pump(min(max(delta, 0.0), 0.02))
+        else:
+            self.net.pump(0.02)
+        self.loop._now = max(self.loop._now, _time.monotonic() - self._origin)
+
     def run_until(self, fut: Future, wall_timeout: float | None = None) -> Any:
         start = _time.monotonic()
         while not fut.done():
             if wall_timeout is not None and _time.monotonic() - start > wall_timeout:
                 raise TimedOut(f"wall timeout {wall_timeout}s")
-            if self.loop._heap:
-                due = self.loop._heap[0][0]
-                delta = (self._origin + due) - _time.monotonic()
-                if delta > 0:
-                    self.net.pump(min(delta, 0.02))
-                else:
-                    # drain everything currently due, then one poll
-                    while (
-                        self.loop._heap
-                        and self._origin + self.loop._heap[0][0]
-                        <= _time.monotonic()
-                    ):
-                        self.loop.run_one()
-                    self.net.pump(0)
-            else:
-                self.net.pump(0.02)
-            # anchor virtual time to the wall so new timers land correctly
-            # (run_one never moves time backwards, so this is always safe)
-            self.loop._now = max(
-                self.loop._now, _time.monotonic() - self._origin
-            )
+            self._tick()
         return fut.result()
 
     def serve_forever(self, wall_timeout: float | None = None) -> None:
         """Pump IO + timers until the deadline (server main loop)."""
         start = _time.monotonic()
         while wall_timeout is None or _time.monotonic() - start < wall_timeout:
-            self.net.pump(0.02)
-            while self.loop._heap:
-                due = self.loop._heap[0][0]
-                if self._origin + due > _time.monotonic():
-                    break
-                self.loop.run_one()
-            self.loop._now = max(
-                self.loop._now, _time.monotonic() - self._origin
-            )
+            self._tick()

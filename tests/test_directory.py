@@ -1,0 +1,153 @@
+"""Directory layer: transactional path -> prefix mapping over the cluster
+(bindings/python/fdb/directory_impl.py surface)."""
+
+import pytest
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.client.directory_layer import DirectoryLayer
+from foundationdb_tpu.client.tuple_layer import pack, unpack, range_of
+
+
+def test_tuple_roundtrip_and_order():
+    cases = [
+        (),
+        (None,),
+        (b"ab", "cd", 0, 7, -7, (1, b"x"), None),
+        (2**40, -(2**40)),
+        (True, False),
+    ]
+    for t in cases:
+        enc = pack(t)
+        got = unpack(enc)
+        want = tuple(int(v) if isinstance(v, bool) else v for v in t)
+        assert got == want, (t, got)
+    # order preservation across mixed ints
+    vals = [-300, -2, 0, 1, 255, 256, 70000]
+    packed = [pack((v,)) for v in vals]
+    assert packed == sorted(packed)
+
+
+def run(c, coro_fn):
+    db = c.database()
+
+    async def main():
+        return await db.run(coro_fn)
+
+    return c.run_until(c.loop.spawn(main()), 120)
+
+
+def test_directory_create_open_list_remove():
+    c = RecoverableCluster(seed=121)
+    dl = DirectoryLayer()
+
+    async def setup(tr):
+        users = await dl.create_or_open(tr, ("app", "users"))
+        events = await dl.create_or_open(tr, ("app", "events"))
+        tr.set(users.pack((1, "name")), b"alice")
+        tr.set(users.pack((2, "name")), b"bob")
+        tr.set(events.pack((1,)), b"login")
+        return users.key, events.key
+
+    ukey, ekey = run(c, setup)
+    assert ukey != ekey and ukey.startswith(b"\xfd")
+
+    async def reopen(tr):
+        users = await dl.open(tr, ("app", "users"))
+        assert users.key == ukey  # stable prefix across transactions
+        rows = await tr.get_range(*users.range())
+        names = [users.unpack(k) for k, _ in rows]
+        kids = await dl.list(tr, ("app",))
+        top = await dl.list(tr, ())
+        return names, kids, top
+
+    names, kids, top = run(c, reopen)
+    assert names == [(1, "name"), (2, "name")]
+    assert sorted(kids) == ["events", "users"]
+    assert top == ["app"]
+
+    async def remove(tr):
+        await dl.remove(tr, ("app", "users"))
+        return (
+            await dl.exists(tr, ("app", "users")),
+            await dl.exists(tr, ("app", "events")),
+            await tr.get_range(ukey, ukey + b"\xff"),
+        )
+
+    gone, events_left, leftover = run(c, remove)
+    assert not gone and events_left and leftover == []
+    c.stop()
+
+
+def test_directory_move_keeps_content():
+    c = RecoverableCluster(seed=122)
+    dl = DirectoryLayer()
+
+    async def setup(tr):
+        d = await dl.create_or_open(tr, ("a", "b"))
+        sub = await dl.create_or_open(tr, ("a", "b", "c"))
+        tr.set(d.pack(("k",)), b"v")
+        tr.set(sub.pack(("k2",)), b"v2")
+        return d.key, sub.key
+
+    dkey, subkey = run(c, setup)
+
+    async def move(tr):
+        moved = await dl.move(tr, ("a", "b"), ("x",))
+        return moved.key
+
+    newkey = run(c, move)
+    assert newkey == dkey  # content prefix untouched by the rename
+
+    async def check(tr):
+        assert not await dl.exists(tr, ("a", "b"))
+        x = await dl.open(tr, ("x",))
+        xc = await dl.open(tr, ("x", "c"))
+        return await tr.get(x.pack(("k",))), await tr.get(xc.pack(("k2",)))
+
+    v, v2 = run(c, check)
+    assert (v, v2) == (b"v", b"v2")
+    c.stop()
+
+
+def test_directory_create_conflicts_are_safe():
+    """Two racing creates of the same path: OCC on the allocator/metadata
+    keys means exactly one allocation wins; the loser retries and opens."""
+    c = RecoverableCluster(seed=123)
+    db = c.database()
+    dl = DirectoryLayer()
+    keys = []
+
+    async def one():
+        async def fn(tr):
+            d = await dl.create_or_open(tr, ("contended",))
+            return d.key
+
+        keys.append(await db.run(fn))
+
+    async def main():
+        from foundationdb_tpu.runtime.combinators import wait_all
+
+        await wait_all([c.loop.spawn(one()) for _ in range(4)])
+
+    c.run_until(c.loop.spawn(main()), 120)
+    assert len(set(keys)) == 1, f"allocation raced: {keys}"
+    c.stop()
+
+
+def test_create_raises_on_existing():
+    c = RecoverableCluster(seed=124)
+    dl = DirectoryLayer()
+
+    async def fn(tr):
+        await dl.create(tr, ("dup",))
+        with pytest.raises(KeyError):
+            await dl.create(tr, ("dup",))
+        return True
+
+    assert run(c, fn)
+    c.stop()
+
+
+def test_range_of():
+    b, e = range_of(("p",))
+    assert b < pack(("p", 1)) < e

@@ -74,3 +74,34 @@ def test_ryw_atomic_fold():
     assert int.from_bytes(local, "little") == 15
     assert int.from_bytes(stored, "little") == 15
     c.stop()
+
+
+def test_limited_range_read_refills_past_buffered_clears():
+    """A limited get_range whose snapshot window is mostly cleared by THIS
+    transaction must keep fetching until the limit is genuinely met — not
+    return a falsely-short result (RYWIterator lockstep semantics)."""
+    from foundationdb_tpu.cluster import SimCluster
+
+    c = SimCluster(seed=55)
+    db = c.database()
+
+    async def main():
+        tr0 = db.create_transaction()
+        for i in range(20):
+            tr0.set(b"k%02d" % i, b"v")
+        await tr0.commit()
+
+        tr = ReadYourWritesTransaction(db)
+        tr.clear_range(b"k00", b"k15")
+        rows = await tr.get_range(b"k00", b"k99", limit=10)
+        assert [k for k, _ in rows] == [b"k%02d" % i for i in range(15, 20)], rows
+        # buffered sets beyond the first snapshot window appear exactly once
+        tr.set(b"k25", b"new")
+        rows2 = await tr.get_range(b"k00", b"k99", limit=10)
+        assert [k for k, _ in rows2] == [
+            b"k15", b"k16", b"k17", b"k18", b"k19", b"k25"
+        ], rows2
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 60)
+    c.stop()

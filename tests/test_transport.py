@@ -60,14 +60,23 @@ def server():
     proc = subprocess.Popen(
         [sys.executable, "-c", SERVER.format(repo=repo)],
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
         text=True,
         env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"},
     )
-    port = int(proc.stdout.readline())
-    yield port
-    proc.kill()
-    proc.wait()
+    try:
+        import select as _select
+
+        ready, _, _ = _select.select([proc.stdout], [], [], 15.0)
+        line = proc.stdout.readline() if ready else ""
+        if not line.strip():
+            proc.kill()
+            err = proc.stderr.read()
+            pytest.fail(f"transport server never started: {err[-2000:]}")
+        yield int(line)
+    finally:
+        proc.kill()
+        proc.wait()
 
 
 def test_cross_process_request_reply(server):
