@@ -10,7 +10,7 @@ remove, move, exists — all transactional.
 Metadata model (simplified vs the reference's node subtree + HCA, same
 observable semantics):
 
-  <node>("alloc",)          -> next prefix counter (atomic add)
+  <node>("alloc",)          -> next prefix counter (OCC read-modify-write)
   <node>("d", *path)        -> the allocated prefix for `path`
 
 Prefixes come from a counter encoded through the tuple layer, so they are
@@ -23,7 +23,6 @@ keeps the API).
 from __future__ import annotations
 
 from .tuple_layer import Subspace, pack
-from ..roles.types import MutationType
 
 
 class Directory(Subspace):
@@ -49,6 +48,26 @@ class DirectoryLayer:
     def _meta_key(self, path: tuple) -> bytes:
         return self._node.pack(("d",) + tuple(path))
 
+    @staticmethod
+    def _require_ryw(tr) -> None:
+        """The allocator and parent-creation logic read their own writes
+        (two allocations in one transaction must see each other's counter
+        bump), so only RYW transactions — db.run's default — are safe."""
+        from .ryw import ReadYourWritesTransaction
+
+        if not isinstance(tr, ReadYourWritesTransaction):
+            raise TypeError(
+                "DirectoryLayer requires a read-your-writes transaction "
+                "(use db.run(fn) or db.create_ryw_transaction())"
+            )
+
+    @staticmethod
+    def _check_path(path: tuple) -> tuple:
+        path = tuple(path)
+        if not path:
+            raise ValueError("directory path must be non-empty")
+        return path
+
     async def _allocate_prefix(self, tr) -> bytes:
         raw = await tr.get(self._alloc_key)
         n = int(raw) if raw is not None else 0
@@ -58,9 +77,8 @@ class DirectoryLayer:
         return b"\xfd" + pack((n,))
 
     async def create_or_open(self, tr, path) -> Directory:
-        path = tuple(path)
-        if not path:
-            raise ValueError("directory path must be non-empty")
+        self._require_ryw(tr)
+        path = self._check_path(path)
         # parents must exist first (the reference auto-creates them)
         for i in range(1, len(path)):
             await self._create_one(tr, path[:i], must_create=False)
@@ -68,14 +86,15 @@ class DirectoryLayer:
         return Directory(self, path, prefix)
 
     async def create(self, tr, path) -> Directory:
-        path = tuple(path)
+        self._require_ryw(tr)
+        path = self._check_path(path)
         for i in range(1, len(path)):
             await self._create_one(tr, path[:i], must_create=False)
         prefix = await self._create_one(tr, path, must_create=True)
         return Directory(self, path, prefix)
 
     async def open(self, tr, path) -> Directory:
-        path = tuple(path)
+        path = self._check_path(path)
         raw = await tr.get(self._meta_key(path))
         if raw is None:
             raise KeyError(f"directory {path!r} does not exist")
@@ -112,7 +131,7 @@ class DirectoryLayer:
 
     async def remove(self, tr, path) -> None:
         """Delete the directory, its subdirectories, and ALL content."""
-        path = tuple(path)
+        path = self._check_path(path)
         raw = await tr.get(self._meta_key(path))
         if raw is None:
             raise KeyError(f"directory {path!r} does not exist")
@@ -129,7 +148,11 @@ class DirectoryLayer:
     async def move(self, tr, old_path, new_path) -> Directory:
         """Rename a directory subtree; allocated prefixes (and therefore all
         content keys) are untouched — only the metadata moves."""
-        old_path, new_path = tuple(old_path), tuple(new_path)
+        self._require_ryw(tr)
+        old_path = self._check_path(old_path)
+        new_path = self._check_path(new_path)
+        if new_path[: len(old_path)] == old_path:
+            raise ValueError("cannot move a directory into its own subtree")
         raw = await tr.get(self._meta_key(old_path))
         if raw is None:
             raise KeyError(f"directory {old_path!r} does not exist")

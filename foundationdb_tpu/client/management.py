@@ -1,0 +1,48 @@
+"""ManagementAPI — cluster configuration through the system keyspace
+(fdbclient/ManagementAPI.actor.cpp changeConfig; fdbclient/SystemData.cpp
+configKeysPrefix `\\xff/conf/`).
+
+Configuration is ordinary replicated, durable data under `\\xff/conf/...`:
+`configure()` commits it like any transaction, and the cluster controller
+polls the range and reacts to changes by running a reconfiguration
+recovery with the new role counts (the reference's master watches the
+txnStateStore config keys and restarts recovery the same way).
+
+Reconfigurable today: n_tlogs, n_proxies, n_resolvers — the write-pipeline
+role counts.  Storage topology changes belong to data distribution.
+"""
+
+from __future__ import annotations
+
+CONF_PREFIX = b"\xff/conf/"
+_FIELDS = ("n_tlogs", "n_proxies", "n_resolvers")
+
+
+async def configure(db, **kwargs) -> None:
+    """Commit new role counts, e.g. configure(db, n_tlogs=3, n_proxies=2).
+    Takes effect at the controller's next conf poll via a recovery."""
+    bad = set(kwargs) - set(_FIELDS)
+    if bad:
+        raise ValueError(f"unknown configuration fields: {sorted(bad)}")
+    for k, v in kwargs.items():
+        if int(v) < 1:
+            raise ValueError(f"{k} must be >= 1")
+
+    async def fn(tr):
+        for k, v in kwargs.items():
+            tr.set(CONF_PREFIX + k.encode(), b"%d" % int(v))
+
+    await db.run(fn)
+
+
+async def get_configuration(db) -> dict:
+    """The committed configuration (empty until first configure())."""
+
+    async def fn(tr):
+        rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+        return {
+            k[len(CONF_PREFIX):].decode(): int(v)
+            for k, v in rows
+        }
+
+    return await db.run(fn)

@@ -145,6 +145,9 @@ class ClusterController:
         self._balance_task = self.loop.spawn(
             self._balance_resolvers(), TaskPriority.COORDINATION, "cc-balance"
         )
+        self._conf_task = self.loop.spawn(
+            self._watch_configuration(), TaskPriority.COORDINATION, "cc-conf"
+        )
 
     # -- recovery state machine --------------------------------------------
     async def _recover(self, first: bool = False) -> None:
@@ -607,6 +610,68 @@ class ClusterController:
 
         self.loop.spawn(kick(), TaskPriority.COORDINATION, "cc-proxy-failure")
 
+    # -- dynamic configuration (ManagementAPI / \xff/conf) -------------------
+    async def _watch_configuration(self) -> None:
+        """Poll the system keyspace's `\xff/conf/` range (written by
+        client/management.py configure()) and run a reconfiguration
+        recovery when the desired write-pipeline role counts change — the
+        reference's master reacts to txnStateStore config-key changes the
+        same way (ManagementAPI.actor.cpp changeConfig; masterserver
+        restarts on configuration version bump)."""
+        from ..client.management import CONF_PREFIX
+
+        view = None
+        while True:
+            await self.loop.delay(
+                self.knobs.CONF_POLL_INTERVAL, TaskPriority.COORDINATION
+            )
+            if self.generation is None or self._recovering:
+                continue
+            if view is None:
+                view = self.make_view(self._cc_proc())
+            db = Database(self.loop, view, self.rng)
+            tr = db.create_transaction()
+            try:
+                rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+            except Exception:  # noqa: BLE001 — recovery window; retry next tick
+                continue
+            conf = {}
+            for k, v in rows:
+                try:
+                    conf[k[len(CONF_PREFIX):].decode()] = int(v)
+                except (ValueError, UnicodeDecodeError):
+                    continue  # a malformed conf row must not kill the watcher
+            # compare DESIRED against the ACTUAL generation — never against
+            # fields mutated by a previous (possibly failed) attempt, or a
+            # committed reconfiguration could be dropped forever
+            gen = self.generation
+            if gen is None or self._recovering:
+                continue
+            want_tlogs = conf.get("n_tlogs", len(gen.tlogs))
+            want_proxies = conf.get("n_proxies", len(gen.proxies))
+            want_res = conf.get("n_resolvers", len(gen.resolvers))
+            if (
+                want_tlogs == len(gen.tlogs)
+                and want_proxies == len(gen.proxies)
+                and want_res == len(gen.resolvers)
+            ):
+                continue
+            self.n_tlogs = want_tlogs
+            self.n_proxies = want_proxies
+            if want_res != len(self.resolver_splits) + 1:
+                # even re-split; the online rebalancer refines it afterwards
+                self.resolver_splits = [
+                    bytes([256 * i // want_res]) for i in range(1, want_res)
+                ]
+            self.trace.trace(
+                "ConfigurationChanged", Epoch=self.epoch,
+                NTlogs=want_tlogs, NProxies=want_proxies, NResolvers=want_res,
+            )
+            try:
+                await self._recover()
+            except Exception:  # noqa: BLE001 — next poll re-detects the
+                continue       # actual-vs-desired mismatch and retries
+
     # -- failure monitoring -------------------------------------------------
     async def _monitor(self) -> None:
         """Heartbeat every pipeline process (the CC's failure monitor; the
@@ -642,6 +707,8 @@ class ClusterController:
     def stop(self) -> None:
         if getattr(self, "_balance_task", None) is not None:
             self._balance_task.cancel()
+        if getattr(self, "_conf_task", None) is not None:
+            self._conf_task.cancel()
         if self._monitor_task is not None:
             self._monitor_task.cancel()
         if self.generation is not None:

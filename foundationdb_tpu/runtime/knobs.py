@@ -60,6 +60,8 @@ class CoreKnobs(Knobs):
         self.init("RESOLUTION_BALANCE_INTERVAL", 0.5)
         self.init("RESOLUTION_BALANCE_RATIO", 2.0)
         self.init("RESOLUTION_BALANCE_MIN_LOAD", 64)
+        # dynamic configuration poll (\xff/conf watcher)
+        self.init("CONF_POLL_INTERVAL", 0.5)
         self.init("SAMPLE_OFFSET_PER_KEY", 100)
         # storage
         self.init("STORAGE_DURABILITY_LAG", 0.05)

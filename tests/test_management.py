@@ -1,0 +1,125 @@
+"""ManagementAPI + system keyspace: live reconfiguration of pipeline role
+counts through \\xff/conf (fdbclient/ManagementAPI.actor.cpp changeConfig)."""
+
+import pytest
+
+from foundationdb_tpu.client.management import configure, get_configuration
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.workloads.base import run_workloads
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+
+
+def test_configure_changes_live_cluster():
+    c = RecoverableCluster(seed=131, n_tlogs=2, n_proxies=2, n_resolvers=1)
+    db = c.database()
+
+    async def main():
+        # write some data first: the reconfiguration recovery must keep it
+        tr = db.create_transaction()
+        for i in range(10):
+            tr.set(b"pre%d" % i, b"v")
+        await tr.commit()
+        await configure(db, n_tlogs=3, n_proxies=1, n_resolvers=2)
+        # wait for the controller to notice and re-recruit
+        for _ in range(200):
+            await c.loop.delay(0.1)
+            gen = c.controller.generation
+            if (
+                gen is not None
+                and not c.controller._recovering
+                and len(gen.tlogs) == 3
+                and len(gen.proxies) == 1
+                and len(gen.resolvers) == 2
+            ):
+                break
+        gen = c.controller.generation
+        conf = await get_configuration(db)
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"pre", b"prf")
+        tr2 = db.create_transaction()
+        tr2.set(b"post", b"alive")
+        await tr2.commit()
+        return (
+            len(gen.tlogs), len(gen.proxies), len(gen.resolvers), conf, len(rows)
+        )
+
+    nt, np_, nr, conf, nrows = c.run_until(c.loop.spawn(main()), 300)
+    assert (nt, np_, nr) == (3, 1, 2)
+    assert conf == {"n_tlogs": 3, "n_proxies": 1, "n_resolvers": 2}
+    assert nrows == 10  # no data lost across the reconfiguration recovery
+    assert c.controller.recoveries >= 1
+    c.stop()
+
+
+def test_configuration_survives_power_loss():
+    c = RecoverableCluster(seed=132)
+    db = c.database()
+
+    async def main():
+        await configure(db, n_tlogs=3)
+        for _ in range(200):
+            await c.loop.delay(0.1)
+            gen = c.controller.generation
+            if gen is not None and not c.controller._recovering and len(gen.tlogs) == 3:
+                return True
+        return False
+
+    assert c.run_until(c.loop.spawn(main()), 300)
+    fs = c.power_off()
+
+    # the restarted cluster starts with the constructor default (2) but must
+    # converge to the durably-committed configuration (3)
+    c2 = RecoverableCluster(seed=133, fs=fs, restart=True)
+    db2 = c2.database()
+
+    async def wait_conf():
+        assert (await get_configuration(db2))["n_tlogs"] == 3
+        for _ in range(200):
+            await c2.loop.delay(0.1)
+            gen = c2.controller.generation
+            if gen is not None and not c2.controller._recovering and len(gen.tlogs) == 3:
+                return True
+        return False
+
+    assert c2.run_until(c2.loop.spawn(wait_conf()), 300)
+    c2.stop()
+
+
+def test_workload_runs_through_reconfiguration():
+    c = RecoverableCluster(seed=134, n_storage_shards=2)
+    db = c.database()
+
+    async def reconf():
+        await c.loop.delay(0.6)
+        await configure(db, n_tlogs=3)
+
+    c.loop.spawn(reconf())
+    cyc = CycleWorkload(nodes=10, clients=3, txns_per_client=10)
+    metrics = run_workloads(c, [cyc], deadline=600.0)
+    assert metrics["Cycle"]["committed"] == 30
+
+    async def wait_reconf():
+        for _ in range(200):
+            gen = c.controller.generation
+            if gen is not None and not c.controller._recovering and len(gen.tlogs) == 3:
+                return True
+            await c.loop.delay(0.1)
+        return False
+
+    assert c.run_until(c.loop.spawn(wait_reconf()), 300)
+    c.stop()
+
+
+def test_configure_validates():
+    c = RecoverableCluster(seed=135)
+    db = c.database()
+
+    async def main():
+        with pytest.raises(ValueError):
+            await configure(db, bogus=1)
+        with pytest.raises(ValueError):
+            await configure(db, n_tlogs=0)
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 60)
+    c.stop()

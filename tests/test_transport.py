@@ -57,10 +57,13 @@ def server():
     import foundationdb_tpu
 
     repo = str(__import__("pathlib").Path(foundationdb_tpu.__file__).parent.parent)
+    import tempfile
+
+    errf = tempfile.TemporaryFile(mode="w+")
     proc = subprocess.Popen(
         [sys.executable, "-c", SERVER.format(repo=repo)],
         stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
+        stderr=errf,  # a file, so a chatty child can never block on a pipe
         text=True,
         env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"},
     )
@@ -71,12 +74,13 @@ def server():
         line = proc.stdout.readline() if ready else ""
         if not line.strip():
             proc.kill()
-            err = proc.stderr.read()
-            pytest.fail(f"transport server never started: {err[-2000:]}")
+            errf.seek(0)
+            pytest.fail(f"transport server never started: {errf.read()[-2000:]}")
         yield int(line)
     finally:
         proc.kill()
         proc.wait()
+        errf.close()
 
 
 def test_cross_process_request_reply(server):
