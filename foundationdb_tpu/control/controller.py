@@ -106,6 +106,13 @@ class ClusterController:
         self.trace = trace
         self.storage = storage
         self.storage_splits = storage_splits
+        # mutable keyServers state (the reference's keyServers system map):
+        # shard i = [bounds[i], bounds[i+1]) served by the team of server
+        # tags in storage_teams_tags[i].  Initialized from the tag naming
+        # convention; data distribution mutates it via
+        # install_storage_assignment.
+        self._tag_to_ss = {ss.tag: ss for ss in storage}
+        self.storage_teams_tags = self._initial_teams_from_tags()
         self.resolver_splits = resolver_splits
         self.make_cs = conflict_backend
         self.n_tlogs = n_tlogs
@@ -335,19 +342,66 @@ class ClusterController:
             return [0]
         return [primary, (primary + 1) % n]
 
-    def _storage_teams(self) -> list[list["StorageServer"]]:
-        """Storage servers grouped by shard, replicas in replica order (the
-        keyServers team map: every shard is served by a team of servers all
-        pulling their own tag for the same key range)."""
+    def _initial_teams_from_tags(self) -> list[list[str]]:
+        """Bootstrap the keyServers map from the tag naming convention
+        ("ss-<shard>-r<replica>"): shard i's team = its replicas' tags."""
         teams: list[list] = [[] for _ in range(len(self.storage_splits) + 1)]
         for ss in self.storage:
             shard, _ = self._parse_tag(ss.tag)
-            teams[shard].append(ss)
+            teams[shard].append(ss.tag)
         for i, t in enumerate(teams):
             if not t:
                 raise ValueError(f"shard {i} has no storage servers")
-            t.sort(key=lambda s: self._parse_tag(s.tag)[1])
+            t.sort(key=lambda tag: self._parse_tag(tag)[1])
         return teams
+
+    def _storage_teams(self) -> list[list["StorageServer"]]:
+        """Storage servers grouped by shard (keyServers team map lookup)."""
+        return [
+            [self._tag_to_ss[t] for t in team] for team in self.storage_teams_tags
+        ]
+
+    def replace_storage_server(self, old: "StorageServer", new: "StorageServer") -> None:
+        """Swap a healed replacement in for a dead server (same tag).  The
+        caller (data distribution) refreshes client views once the
+        replacement's ranges are live."""
+        assert old.tag == new.tag
+        self._tag_to_ss[new.tag] = new
+        self.storage[self.storage.index(old)] = new
+
+    async def install_storage_assignment(
+        self, new_splits: list[bytes], new_teams: list[list[str]]
+    ) -> Version | None:
+        """Atomically swap the keyServers map on every proxy at a drained
+        version boundary, then refresh every client view.  Returns the
+        boundary version (mutations above it follow the new map), or None
+        if a recovery raced the drain (caller retries).
+
+        The reference gets this atomicity by committing keyServers changes
+        through the pipeline (MoveKeys.actor.cpp startMoveKeys/
+        finishMoveKeys txns); draining the commit plane is our equivalent
+        serialization point."""
+        gen = self.generation
+        if gen is None or self._recovering:
+            return None
+        for p in gen.proxies:
+            p.pause_commits()
+        try:
+            await self._wait_commit_drain(gen)
+            if gen is not self.generation or self._recovering:
+                return None
+            pmap = KeyPartitionMap(list(new_splits), [list(t) for t in new_teams])
+            t2t = {t: self._tag_tlogs(t) for team in new_teams for t in team}
+            for p in gen.proxies:
+                p.install_storage_map(pmap, t2t)
+            self.storage_splits = list(new_splits)
+            self.storage_teams_tags = [list(t) for t in new_teams]
+            for view in self.views:
+                self._fill_view(view)
+            return gen.sequencer._last_assigned
+        finally:
+            for p in gen.proxies:
+                p.resume_commits()
 
     def _cc_proc(self) -> SimProcess:
         if not hasattr(self, "_cc_process"):

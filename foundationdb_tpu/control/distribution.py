@@ -1,0 +1,300 @@
+"""Data distribution — shard movement, splitting, and team healing
+(fdbserver/DataDistribution.actor.cpp:562 dataDistributionTracker/QueueData;
+MoveKeys.actor.cpp:875 startMoveKeys/finishMoveKeys;
+storageserver.actor.cpp fetchKeys).
+
+The distributor owns the keyServers map's evolution:
+
+  * **move_range** — the MoveKeys dance, re-designed around this runtime's
+    drained-version-boundary primitive instead of the reference's
+    system-keyspace transactions:
+      1. install a DUAL map at a drained boundary vm: the range's mutations
+         are tagged to both the source and destination teams from vm on,
+      2. each destination server runs fetchKeys (buffer its tag stream for
+         the range, snapshot-read the source team, replay the buffer),
+      3. once every destination is live, install the FINAL map (destination
+         only) at a second drained boundary and refresh client views,
+      4. after a safety delay (in-flight reads at old versions), the source
+         team drops the range.
+  * **splitting** — a shard whose key count exceeds DD_SHARD_SPLIT_KEYS is
+    split at its median key and the hot half moved to the smallest team
+    (dataDistributionTracker shardSplitter).
+  * **healing** — a storage server that stops answering pings is replaced:
+    a fresh server takes over the dead one's TAG (so the proxies' maps and
+    the TLogs' tag streams are untouched) and fetchKeys-es every range the
+    tag serves from its surviving teammates (teamTracker + the storage
+    recruitment half of DataDistribution).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from ..roles.storage import MemoryKeyValueStore, StorageServer
+from ..rpc.network import Endpoint
+from ..rpc.stream import RequestStream, RequestStreamRef
+from ..runtime.combinators import wait_all
+from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
+from ..runtime.knobs import CoreKnobs
+
+WLT_SS_PING = "wlt:ss_ping"
+
+
+class DataDistributor:
+    def __init__(
+        self,
+        loop: EventLoop,
+        net,
+        knobs: CoreKnobs,
+        controller,
+        store_factory=None,  # (tag, process) -> IKeyValueStore for healing
+    ) -> None:
+        self.loop = loop
+        self.net = net
+        self.knobs = knobs
+        self.cc = controller
+        self.store_factory = store_factory or (
+            lambda tag, proc: MemoryKeyValueStore()
+        )
+        self.moves = 0
+        self.heals = 0
+        self.shard_splits = 0
+        self._moving = False
+        self._heal_seq = 0
+        self._pong_tasks: dict[str, object] = {}
+        for ss in controller.storage:
+            self._watch(ss)
+        self._tasks = [
+            loop.spawn(self._heal_loop(), TaskPriority.COORDINATION, "dd-heal"),
+            loop.spawn(self._split_loop(), TaskPriority.COORDINATION, "dd-split"),
+        ]
+
+    # -- failure detection ---------------------------------------------------
+    def _watch(self, ss: StorageServer) -> None:
+        """Register a ping responder on the server's process (the storage
+        half of the CC's failure monitor; a killed process stops answering)."""
+        rs = RequestStream(ss.process, WLT_SS_PING)
+
+        async def pong() -> None:
+            while True:
+                req = await rs.next()
+                req.reply("pong")
+
+        old = self._pong_tasks.pop(ss.tag, None)
+        if old is not None:
+            old.cancel()
+        self._pong_tasks[ss.tag] = self.loop.spawn(
+            pong(), TaskPriority.COORDINATION, f"dd-pong-{ss.tag}"
+        )
+
+    async def _heal_loop(self) -> None:
+        cc = self.cc
+        while True:
+            await self.loop.delay(self.knobs.DD_PING_INTERVAL, TaskPriority.COORDINATION)
+            if cc.generation is None or cc._recovering:
+                continue
+            ping_proc = cc._cc_proc()
+            for ss in list(cc.storage):
+                ref = RequestStreamRef(
+                    self.net, ping_proc, Endpoint(ss.process.address, WLT_SS_PING)
+                )
+                try:
+                    await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
+                except (TimedOut, BrokenPromise):
+                    if cc._tag_to_ss.get(ss.tag) is ss:  # not already healed
+                        try:
+                            await self._heal(ss)
+                        except (TimedOut, BrokenPromise):
+                            continue  # mid-recovery; next tick retries
+
+    async def _heal(self, dead: StorageServer) -> None:
+        cc = self.cc
+        tag = dead.tag
+        bounds = [b""] + list(cc.storage_splits) + [None]
+        ranges: list[tuple[bytes, bytes | None, list[str]]] = []
+        for i, team in enumerate(cc.storage_teams_tags):
+            if tag in team:
+                srcs = [t for t in team if t != tag]
+                if not srcs:
+                    cc.trace.trace(
+                        "DDHealImpossible", Tag=tag, Shard=i,
+                        Reason="no surviving replica",
+                    )
+                    return
+                ranges.append((bounds[i], bounds[i + 1], srcs))
+        self._heal_seq += 1
+        dead.stop()  # before reopening its store file: no straggler writes
+        proc = self.net.create_process(f"storage-heal{self._heal_seq}-{tag}")
+        store = self.store_factory(tag, proc)
+        gen = cc.generation
+        tlog = gen.tlogs[cc._tag_tlogs(tag)[0]]
+        # start below every surviving replica's applied version: mutations
+        # between start and the fetch snapshot are covered by the snapshot,
+        # and the tag stream fills in everything after
+        start_v = min(
+            (cc._tag_to_ss[t].version.get() for _b, _e, ts in ranges for t in ts),
+            default=0,
+        )
+        new_ss = StorageServer(
+            proc, self.loop, self.knobs,
+            tlog_peek_ref=RequestStreamRef(self.net, proc, tlog.peek_stream.endpoint),
+            tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
+            tag=tag, store=store, start_version=start_v,
+        )
+        cc.replace_storage_server(dead, new_ss)
+        self._watch(new_ss)
+        futs = []
+        for b, e, src_tags in ranges:
+            refs = [
+                RequestStreamRef(
+                    self.net, proc, cc._tag_to_ss[t].getkv_stream.endpoint
+                )
+                for t in src_tags
+            ]
+            futs.append(new_ss.start_fetch(b, e, start_v, refs))
+        await wait_all(futs)
+        for view in cc.views:
+            cc._fill_view(view)
+        self.heals += 1
+        cc.trace.trace(
+            "DDHealed", Tag=tag, Ranges=len(ranges), StartVersion=start_v,
+        )
+
+    # -- shard splitting -----------------------------------------------------
+    async def _split_loop(self) -> None:
+        cc = self.cc
+        while True:
+            await self.loop.delay(self.knobs.DD_SPLIT_INTERVAL, TaskPriority.COORDINATION)
+            if cc.generation is None or cc._recovering or self._moving:
+                continue
+            teams = cc.storage_teams_tags
+            if len(teams) < 2:
+                continue
+            bounds = [b""] + list(cc.storage_splits) + [None]
+            sizes = []
+            for i, team in enumerate(teams):
+                b, e = bounds[i], bounds[i + 1]
+                ss = cc._tag_to_ss[team[0]]
+                sizes.append(
+                    ss.store.count_range(b, e if e is not None else b"\xff\xff\xff\xff\xff\xff")
+                )
+            hot = max(range(len(sizes)), key=lambda i: sizes[i])
+            if sizes[hot] <= self.knobs.DD_SHARD_SPLIT_KEYS:
+                continue
+            cold = min(
+                (i for i in range(len(sizes)) if set(teams[i]) != set(teams[hot])),
+                key=lambda i: sizes[i],
+                default=None,
+            )
+            if cold is None:
+                continue
+            b, e = bounds[hot], bounds[hot + 1]
+            ss = cc._tag_to_ss[teams[hot][0]]
+            key = ss.store.middle_key(
+                b, e if e is not None else b"\xff\xff\xff\xff\xff\xff"
+            )
+            if key is None:
+                continue
+            moved = await self.move_range(key, e, list(teams[cold]))
+            if moved:
+                self.shard_splits += 1
+                cc.trace.trace(
+                    "DDShardSplit", SplitKey=repr(key), From=hot, To=cold,
+                    HotKeys=sizes[hot],
+                )
+
+    # -- MoveKeys ------------------------------------------------------------
+    async def move_range(
+        self, begin: bytes, end: bytes | None, dest_team: list[str]
+    ) -> bool:
+        """Move [begin, end) to dest_team.  The range must lie inside a
+        single current shard.  Returns False (no state changed) if the move
+        could not start; retries internally across recoveries once the dual
+        map is installed, because from that point the map must converge."""
+        if self._moving:
+            return False
+        self._moving = True
+        try:
+            return await self._move_range(begin, end, dest_team)
+        finally:
+            self._moving = False
+
+    async def _move_range(
+        self, begin: bytes, end: bytes | None, dest_team: list[str]
+    ) -> bool:
+        cc = self.cc
+        splits = list(cc.storage_splits)
+        teams = [list(t) for t in cc.storage_teams_tags]
+        bounds: list = [b""] + splits + [None]
+        i = bisect.bisect_right(splits, begin)
+        lo, hi = bounds[i], bounds[i + 1]
+        within = (hi is None) if end is None else (hi is None or end <= hi)
+        if not (lo <= begin and within and (end is None or begin < end)):
+            return False
+        src_team = teams[i]
+        if set(src_team) == set(dest_team):
+            return False
+        dual = src_team + [t for t in dest_team if t not in src_team]
+
+        # boundary keys begin/end partition shard i; the moving segment
+        # gets the dual team, flanking remnants keep the source team
+        seg_splits, seg_teams = [], []
+        if begin > lo:
+            seg_splits.append(begin)
+            seg_teams.append(list(src_team))
+        seg_teams.append(dual)
+        if end is not None and (hi is None or end < hi):
+            seg_splits.append(end)
+            seg_teams.append(list(src_team))
+        new_splits = splits[:i] + seg_splits + splits[i:]
+        new_teams = teams[:i] + seg_teams + teams[i + 1:]
+
+        vm = await cc.install_storage_assignment(new_splits, new_teams)
+        if vm is None:
+            return False  # recovery raced the dual install; nothing changed
+
+        src_servers = [cc._tag_to_ss[t] for t in src_team]
+        dest_new = [cc._tag_to_ss[t] for t in dest_team if t not in src_team]
+        futs = []
+        for d in dest_new:
+            refs = [
+                RequestStreamRef(self.net, d.process, s.getkv_stream.endpoint)
+                for s in src_servers
+            ]
+            futs.append(d.start_fetch(begin, end, vm, refs))
+        await wait_all(futs)
+
+        # flip to the final map; a racing recovery re-recruits with the dual
+        # map (harmless — both teams keep getting the data), so just retry
+        seg_idx = new_teams.index(dual)
+        final_teams = [list(t) for t in new_teams]
+        final_teams[seg_idx] = list(dest_team)
+        while True:
+            v2 = await cc.install_storage_assignment(new_splits, final_teams)
+            if v2 is not None:
+                break
+            await self.loop.delay(0.1, TaskPriority.COORDINATION)
+        self.moves += 1
+        cc.trace.trace(
+            "DDMoveComplete", Begin=repr(begin), End=repr(end),
+            Dest=dest_team, Boundary=vm,
+        )
+
+        async def drop_source() -> None:
+            # in-flight reads hold versions below the flip; give them the
+            # read-timeout window before discarding the source copy
+            await self.loop.delay(1.5, TaskPriority.COORDINATION)
+            for s in src_servers:
+                if s.tag not in dest_team and cc._tag_to_ss.get(s.tag) is s:
+                    s.drop_range(begin, end)
+
+        self._tasks.append(
+            self.loop.spawn(drop_source(), TaskPriority.COORDINATION, "dd-drop")
+        )
+        return True
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._pong_tasks.values():
+            t.cancel()

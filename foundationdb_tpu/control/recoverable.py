@@ -72,7 +72,7 @@ class RecoverableCluster:
         def splits(n: int) -> list[bytes]:
             return [bytes([256 * i // n]) for i in range(1, n)]
 
-        self.storage_splits = splits(n_storage_shards)
+        self._initial_storage_splits = splits(n_storage_shards)
         resolver_splits = splits(n_resolvers)
 
         self.coordinators = [
@@ -122,7 +122,7 @@ class RecoverableCluster:
         self.controller = ClusterController(
             self.loop, self.net, self.knobs, self.rng, self.trace,
             storage=self.storage,
-            storage_splits=self.storage_splits,
+            storage_splits=self._initial_storage_splits,
             conflict_backend=make_cs,
             resolver_splits=resolver_splits,
             n_tlogs=n_tlogs,
@@ -145,6 +145,33 @@ class RecoverableCluster:
         for p in self.controller.generation.proxies:
             p.ratekeeper = self.ratekeeper
 
+        from .distribution import DataDistributor
+
+        def _heal_store(tag: str, proc):
+            """A replacement server takes over the dead one's store FILE as
+            well as its tag: the restart path recovers per-tag `ss{i}r{r}.kv`
+            names, so the healed data must live there, and the dead file's
+            durable prefix is a head start the snapshot fetch grounds over."""
+            if self.fs is not None:
+                from ..storage.kvstore import DurableMemoryKeyValueStore
+
+                shard, rep = ClusterController._parse_tag(tag)
+                path = f"ss{shard}r{rep}.kv"
+                if self.fs.exists(path):
+                    return DurableMemoryKeyValueStore.recover(self.fs, path, proc)
+                return DurableMemoryKeyValueStore(self.fs, path, proc)
+            return MemoryKeyValueStore()
+
+        self.dd = DataDistributor(
+            self.loop, self.net, self.knobs, self.controller,
+            store_factory=_heal_store,
+        )
+
+    @property
+    def storage_splits(self) -> list[bytes]:
+        """The LIVE shard boundaries (data distribution mutates them)."""
+        return self.controller.storage_splits
+
     def storage_teams(self):
         """Storage servers grouped per shard (replicas in replica order)."""
         return self.controller._storage_teams()
@@ -166,6 +193,7 @@ class RecoverableCluster:
             cluster2 = RecoverableCluster(seed=..., fs=fs, restart=True)
         """
         assert self.fs is not None, "power_off needs a durable cluster"
+        self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()
         for c in self.coordinators:
@@ -177,6 +205,7 @@ class RecoverableCluster:
         return self.fs
 
     def stop(self) -> None:
+        self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()
         for c in self.coordinators:

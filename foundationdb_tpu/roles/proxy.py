@@ -145,7 +145,7 @@ class CommitProxy:
         self.c_throttled = self.counters.counter("mvcc_window_throttles")
         self._pending: list[_PendingCommit] = []
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
-        self._paused = False    # resolutionBalancing drain barrier
+        self._paused = 0        # drain barrier refcount (rebalance + DD)
         self._inflight = 0      # commit batches between spawn andcompletion
         self._tasks = [
             loop.spawn(self._accept_commits(), TaskPriority.PROXY_COMMIT, "proxy-accept"),
@@ -175,11 +175,24 @@ class CommitProxy:
 
     def pause_commits(self) -> None:
         """Hold new commit batches (requests keep queueing in _pending);
-        in-flight batches drain — the rebalance version-boundary barrier."""
-        self._paused = True
+        in-flight batches drain — the rebalance version-boundary barrier.
+        Counted: resolver rebalancing and data distribution may both drain
+        the plane at once."""
+        self._paused += 1
 
     def resume_commits(self) -> None:
-        self._paused = False
+        self._paused = max(0, self._paused - 1)
+
+    def install_storage_map(
+        self, pmap: KeyPartitionMap, tag_to_tlogs: dict[str, list[int]]
+    ) -> None:
+        """Swap the keyServers map (data distribution move/split boundary).
+        Only called by the controller inside a drained pause — with no batch
+        in flight the swap needs no version-indexed history, unlike the
+        resolver map (reference: MoveKeys commits the keyServers change
+        through the pipeline itself, MoveKeys.actor.cpp:875)."""
+        self.tags = pmap
+        self.tag_to_tlogs = dict(tag_to_tlogs)
 
     @property
     def inflight_batches(self) -> int:

@@ -73,8 +73,27 @@ class MemoryKeyValueStore:
     def key_count(self) -> int:
         return len(self._keys)
 
+    def count_range(self, begin: bytes, end: bytes) -> int:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        return hi - lo
+
+    def middle_key(self, begin: bytes, end: bytes) -> bytes | None:
+        """Median key of [begin, end) — the data-distribution split-point
+        sample (the reference samples byte-weighted splits via
+        StorageMetrics; key-median is our stand-in)."""
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        if hi - lo < 2:
+            return None
+        return self._keys[(lo + hi) // 2]
+
 
 _CLEARED = object()  # tombstone marker in version chains
+
+# end-of-keyspace sentinel for half-open ranges whose end is None (sorts
+# above the `\xff/...` system keyspace)
+TOP_KEY = b"\xff\xff\xff\xff\xff\xff"
 
 
 class VersionedOverlay:
@@ -197,6 +216,16 @@ class VersionedOverlay:
                     del self._chains[key]
         self._chain_keys = sorted(self._chains)
 
+    def purge_range(self, begin: bytes, end: bytes) -> None:
+        """Drop every chain in [begin, end) (data distribution: a shard
+        moved away; clear-history entries inside the range are left — they
+        hide nothing once the base is cleared too)."""
+        lo = bisect.bisect_left(self._chain_keys, begin)
+        hi = bisect.bisect_left(self._chain_keys, end)
+        for k in self._chain_keys[lo:hi]:
+            del self._chains[k]
+        del self._chain_keys[lo:hi]
+
     def rollback_to(self, version: Version) -> None:
         """Discard every entry/clear with version > version (recovery: a
         storage server may have applied mutations a failed TLog replica
@@ -212,6 +241,27 @@ class VersionedOverlay:
         self._chain_keys = sorted(self._chains)
         self._clears = [c for c in self._clears if c[0] <= version]
         self._stab_dirty = True
+
+
+class _FetchState:
+    """An in-progress fetchKeys (storageserver.actor.cpp fetchKeys: the dest
+    of a shard move buffers its tag-stream mutations for the moving range
+    while it reads a snapshot from the source team, then replays the buffer
+    on top)."""
+
+    def __init__(self, begin: bytes, end: bytes | None, boundary: Version) -> None:
+        self.begin = begin
+        self.end = end
+        self.boundary = boundary  # first version the dest tag covers the range
+        self.buffer: list[tuple[Version, Mutation]] = []
+        self.epoch = 0  # bumped by rollback: in-flight snapshot is stale
+
+    @property
+    def end_key(self) -> bytes:
+        return TOP_KEY if self.end is None else self.end
+
+    def covers(self, key: bytes) -> bool:
+        return self.begin <= key < self.end_key
 
 
 class StorageServer:
@@ -248,6 +298,11 @@ class StorageServer:
         # bumped by set_tlog_source: a peek reply awaited across a rollback
         # must be discarded, not applied (it may carry phantom versions)
         self._pull_epoch = 0
+        # data distribution state: ranges being fetched (mutations buffered)
+        # and per-range read floors (a moved-in range is only readable at or
+        # above its snapshot version)
+        self._fetching: list[_FetchState] = []
+        self._range_floor: list[tuple[bytes, bytes, Version]] = []
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES)
         self.watch_stream = RequestStream(process, self.WLT_WATCH)
@@ -283,18 +338,131 @@ class StorageServer:
             for version, muts in reply.entries:
                 if version <= self.version.get():
                     continue
-                for m in muts:
+                live = self._route_fetching(version, muts) if self._fetching else muts
+                for m in live:
                     self.overlay.apply(version, m, self.store.get)
                 self.version.set(version)
                 self._fetched = version
-                if self._watches:
-                    self._fire_watches(muts)
+                if self._watches and live:
+                    self._fire_watches(live)
             if reply.end_version - 1 > self.version.get():
                 # tlog knows newer versions with no data for our tag
                 self.version.set(reply.end_version - 1)
                 self._fetched = reply.end_version - 1
             if not reply.entries:
                 await self.loop.delay(0.005, TaskPriority.STORAGE_SERVER)
+
+    def _route_fetching(self, version: Version, muts) -> list[Mutation]:
+        """Split a tag-stream batch between live apply and fetch buffers.
+
+        Point mutations inside a fetching range are buffered whole; a
+        clear-range has its fetching overlap buffered (clipped) AND is still
+        applied live in full — clearing keys this server doesn't hold is a
+        no-op, and the same-version duplicate on replay is idempotent."""
+        live: list[Mutation] = []
+        for m in muts:
+            if m.type == MutationType.CLEAR_RANGE:
+                for fs in self._fetching:
+                    b = max(m.key, fs.begin)
+                    e = min(m.value, fs.end_key)
+                    if b < e:
+                        fs.buffer.append(
+                            (version, Mutation(MutationType.CLEAR_RANGE, b, e))
+                        )
+                live.append(m)
+            else:
+                fs = next((f for f in self._fetching if f.covers(m.key)), None)
+                if fs is not None:
+                    fs.buffer.append((version, m))
+                else:
+                    live.append(m)
+        return live
+
+    # -- fetchKeys (data distribution dest side) -----------------------------
+    def start_fetch(self, begin: bytes, end: bytes | None, boundary: Version,
+                    sources: list[RequestStreamRef]):
+        """Begin owning [begin, end): buffer its tag-stream mutations and
+        fetch a snapshot from the source team's read endpoints
+        (storageserver.actor.cpp fetchKeys).  Returns a Future resolving to
+        the snapshot version once the range is live here."""
+        fs = _FetchState(begin, end, boundary)
+        self._fetching.append(fs)
+        task = self.loop.spawn(
+            self._fetch_keys(fs, sources), TaskPriority.STORAGE_SERVER,
+            f"ss-fetch-{self.tag}",
+        )
+        self._tasks.append(task)
+        return task
+
+    async def _fetch_keys(self, fs: _FetchState, sources: list[RequestStreamRef]) -> Version:
+        si = 0
+        while True:
+            epoch = fs.epoch
+            # snapshot at a version this server has already seen committed:
+            # >= boundary so nothing between boundary and snapshot is missed
+            # (those mutations are IN the snapshot; buffered copies <= V are
+            # skipped at replay)
+            snap_v = max(self.version.get(), fs.boundary)
+            rows: list[tuple[bytes, bytes]] = []
+            b = fs.begin
+            ok = True
+            while True:
+                ref = sources[si % len(sources)]
+                try:
+                    reply = await ref.get_reply(
+                        GetKeyValuesRequest(b, fs.end_key, snap_v, 5000), timeout=2.0
+                    )
+                except (TimedOut, BrokenPromise, TransactionTooOld, FutureVersion):
+                    si += 1  # rotate replica / refresh the snapshot version
+                    ok = False
+                    break
+                rows.extend(reply.data)
+                if not reply.more:
+                    break
+                from ..keys import key_after
+
+                b = key_after(rows[-1][0])
+            if not ok or fs.epoch != epoch:
+                await self.loop.delay(0.05, TaskPriority.STORAGE_SERVER)
+                continue
+            self._finalize_fetch(fs, snap_v, rows)
+            return snap_v
+
+    def _finalize_fetch(self, fs: _FetchState, snap_v: Version,
+                        rows: list[tuple[bytes, bytes]]) -> None:
+        """Synchronous (no awaits → no interleaved pulls): ground the range,
+        lay the snapshot down at snap_v, replay buffered mutations above it,
+        then open the range for reads at floor snap_v."""
+        self.overlay.apply(
+            snap_v, Mutation(MutationType.CLEAR_RANGE, fs.begin, fs.end_key),
+            self.store.get,
+        )
+        for k, val in rows:
+            self.overlay.apply(snap_v, Mutation(MutationType.SET_VALUE, k, val),
+                               self.store.get)
+        for version, m in fs.buffer:
+            if version > snap_v:
+                self.overlay.apply(version, m, self.store.get)
+        self._fetching.remove(fs)
+        self._range_floor.append((fs.begin, fs.end_key, snap_v))
+
+    def drop_range(self, begin: bytes, end: bytes | None) -> None:
+        """Discard [begin, end) (the source side after a completed move)."""
+        end_k = TOP_KEY if end is None else end
+        self.store.clear_range(begin, end_k)
+        self.overlay.purge_range(begin, end_k)
+        self._range_floor = [
+            (b, e, v) for b, e, v in self._range_floor
+            if not (begin <= b and e <= end_k)
+        ]
+
+    def _floor_violation(self, begin: bytes, end: bytes, version: Version) -> bool:
+        """True if any overlapping moved-in range has floor > version (its
+        pre-snapshot history lives only on the old team)."""
+        return any(
+            v > version and b < end and begin < e
+            for b, e, v in self._range_floor
+        )
 
     async def _durability(self) -> None:
         while True:
@@ -342,6 +510,12 @@ class StorageServer:
         await maybe_delay(self.loop, "storage.delay_read")
         try:
             await self._wait_version(r.version)
+            if any(fs.covers(r.key) for fs in self._fetching):
+                raise FutureVersion("key is still being fetched (shard move)")
+            if self._floor_violation(r.key, r.key + b"\x00", r.version):
+                raise TransactionTooOld(
+                    f"version {r.version} below moved-shard floor"
+                )
         except (TransactionTooOld, FutureVersion) as e:
             req.reply_error(e)
             return
@@ -389,6 +563,14 @@ class StorageServer:
         r: GetKeyValuesRequest = req.payload
         try:
             await self._wait_version(r.version)
+            if any(
+                fs.begin < r.end and r.begin < fs.end_key for fs in self._fetching
+            ):
+                raise FutureVersion("range is still being fetched (shard move)")
+            if self._floor_violation(r.begin, r.end, r.version):
+                raise TransactionTooOld(
+                    f"version {r.version} below moved-shard floor"
+                )
         except (TransactionTooOld, FutureVersion) as e:
             req.reply_error(e)
             return
@@ -424,6 +606,13 @@ class StorageServer:
         if recovery_version is not None:
             # everything <= recovery_version is committed cluster-wide
             self.known_committed = max(self.known_committed, recovery_version)
+        if recovery_version is not None:
+            # fetch state above the recovery version is phantom: buffered
+            # mutations evaporate with it, and a snapshot taken at a rolled-
+            # back version must be refetched
+            for fs in self._fetching:
+                fs.buffer = [e for e in fs.buffer if e[0] <= recovery_version]
+                fs.epoch += 1
         if recovery_version is not None and self.version.get() > recovery_version:
             # unreachable unless the knownCommittedVersion bound was violated
             assert self.durable_version <= recovery_version, (

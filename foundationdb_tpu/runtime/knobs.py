@@ -77,6 +77,13 @@ class CoreKnobs(Knobs):
         self.init("TARGET_QUEUE_BYTES", 1 << 27)
         self.init("RATEKEEPER_UPDATE_INTERVAL", 0.25)
 
+        # data distribution (DataDistribution.actor.cpp): storage failure
+        # ping cadence, shard-size poll cadence, and the split threshold
+        # (the reference splits on byte size via StorageMetrics; we count keys)
+        self.init("DD_PING_INTERVAL", 0.25)
+        self.init("DD_SPLIT_INTERVAL", 0.5)
+        self.init("DD_SHARD_SPLIT_KEYS", 100_000)
+
     @property
     def mvcc_window_versions(self) -> int:
         return int(self.VERSIONS_PER_SECOND * self.MAX_WRITE_TRANSACTION_LIFE)
