@@ -82,6 +82,8 @@ class GenerationRoles:
 class ClusterController:
     """Owns generations of the write pipeline over a pool of workers."""
 
+    KEYSERVERS_PATH = "keyservers.meta"
+
     def __init__(
         self,
         loop: EventLoop,
@@ -120,6 +122,11 @@ class ClusterController:
         self.cstate = cstate
         self.fs = fs
         self.restart = restart
+        if restart and fs is not None and fs.exists(self.KEYSERVERS_PATH):
+            # data distribution moved shards in a previous life: the on-disk
+            # keyServers map, not the tag naming convention, says where the
+            # durable data actually lives
+            self._recover_key_servers()
         self.epoch = 0
         self.recoveries = 0
         self.resolver_moves = 0
@@ -368,6 +375,62 @@ class ClusterController:
         assert old.tag == new.tag
         self._tag_to_ss[new.tag] = new
         self.storage[self.storage.index(old)] = new
+
+    # -- keyServers persistence (data distribution across restarts) ---------
+    def _keyservers_dq(self):
+        from ..storage.diskqueue import DiskQueue
+
+        if not hasattr(self, "_ks_dq"):
+            self._ks_dq = DiskQueue(
+                self.fs.open(self.KEYSERVERS_PATH, self._cc_proc())
+            )
+        return self._ks_dq
+
+    async def persist_key_servers(
+        self, splits: list[bytes], teams: list[list[str]]
+    ) -> None:
+        """Durably record a keyServers assignment (the reference keeps it in
+        the `\\xff/keyServers/` system keyspace, which is itself replicated
+        storage; a flat fsynced file is our equivalent).  Data distribution
+        persists only assignments whose data is already durable where the
+        map points — never a mid-move dual state whose destination holds the
+        range only in memory."""
+        if self.fs is None:
+            return
+        from ..runtime.serialize import BinaryWriter
+
+        w = BinaryWriter().u32(len(splits))
+        for s in splits:
+            w.bytes_(s)
+        w.u32(len(teams))
+        for t in teams:
+            w.u32(len(t))
+            for tag in t:
+                w.str_(tag)
+        dq = self._keyservers_dq()
+        dq.rewrite([w.data()])
+        await dq.sync()
+
+    def _recover_key_servers(self) -> None:
+        from ..runtime.serialize import BinaryReader
+
+        try:
+            records = self._keyservers_dq().recover()
+            if not records:
+                return
+            r = BinaryReader(records[-1])
+            splits = [r.bytes_() for _ in range(r.u32())]
+            teams = [
+                [r.str_() for _ in range(r.u32())] for _ in range(r.u32())
+            ]
+        except Exception:  # noqa: BLE001 — torn write: fall back to the
+            return         # tag-convention map (valid pre-first-move state)
+        if len(teams) != len(splits) + 1:
+            return
+        if not all(t in self._tag_to_ss for team in teams for t in team):
+            return  # names a server that no longer exists: stale file
+        self.storage_splits = splits
+        self.storage_teams_tags = teams
 
     async def install_storage_assignment(
         self, new_splits: list[bytes], new_teams: list[list[str]]

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import bisect
 
-from ..roles.storage import MemoryKeyValueStore, StorageServer
+from ..roles.storage import TOP_KEY, MemoryKeyValueStore, StorageServer
 from ..rpc.network import Endpoint
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all
@@ -152,7 +152,18 @@ class DataDistributor:
                 for t in src_tags
             ]
             futs.append(new_ss.start_fetch(b, e, start_v, refs))
-        await wait_all(futs)
+        try:
+            await wait_all(futs)
+        except (TimedOut, BrokenPromise):
+            # sources unreachable for the whole bounded fetch: kill the
+            # half-empty replacement so the next ping cycle re-heals from
+            # scratch (reads meanwhile fail over to survivors)
+            for f in futs:
+                f.cancel()
+            new_ss.process.kill()
+            new_ss.stop()
+            cc.trace.trace("DDHealRetry", Tag=tag)
+            return
         for view in cc.views:
             cc._fill_view(view)
         self.heals += 1
@@ -175,9 +186,7 @@ class DataDistributor:
             for i, team in enumerate(teams):
                 b, e = bounds[i], bounds[i + 1]
                 ss = cc._tag_to_ss[team[0]]
-                sizes.append(
-                    ss.store.count_range(b, e if e is not None else b"\xff\xff\xff\xff\xff\xff")
-                )
+                sizes.append(ss.store.count_range(b, e if e is not None else TOP_KEY))
             hot = max(range(len(sizes)), key=lambda i: sizes[i])
             if sizes[hot] <= self.knobs.DD_SHARD_SPLIT_KEYS:
                 continue
@@ -190,9 +199,7 @@ class DataDistributor:
                 continue
             b, e = bounds[hot], bounds[hot + 1]
             ss = cc._tag_to_ss[teams[hot][0]]
-            key = ss.store.middle_key(
-                b, e if e is not None else b"\xff\xff\xff\xff\xff\xff"
-            )
+            key = ss.store.middle_key(b, e if e is not None else TOP_KEY)
             if key is None:
                 continue
             moved = await self.move_range(key, e, list(teams[cold]))
@@ -202,6 +209,18 @@ class DataDistributor:
                     "DDShardSplit", SplitKey=repr(key), From=hot, To=cold,
                     HotKeys=sizes[hot],
                 )
+
+    def _tag_serves_overlap(self, tag: str, begin: bytes, end: bytes | None) -> bool:
+        """Does the CURRENT keyServers map route any of [begin, end) to tag?"""
+        cc = self.cc
+        bounds = [b""] + list(cc.storage_splits) + [None]
+        for j, team in enumerate(cc.storage_teams_tags):
+            if tag not in team:
+                continue
+            b, e = bounds[j], bounds[j + 1]
+            if (end is None or b < end) and (e is None or begin < e):
+                return True
+        return False
 
     # -- MoveKeys ------------------------------------------------------------
     async def move_range(
@@ -249,9 +268,16 @@ class DataDistributor:
         new_splits = splits[:i] + seg_splits + splits[i:]
         new_teams = teams[:i] + seg_teams + teams[i + 1:]
 
+        seg_idx = i + (1 if begin > lo else 0)
         vm = await cc.install_storage_assignment(new_splits, new_teams)
         if vm is None:
             return False  # recovery raced the dual install; nothing changed
+        # persist the SOURCE-ONLY shape of the new boundaries: a restart
+        # mid-move must forget the move (the destination's copy is not
+        # durable yet) but keep shard boundaries consistent
+        src_only = [list(t) for t in new_teams]
+        src_only[seg_idx] = list(src_team)
+        await cc.persist_key_servers(new_splits, src_only)
 
         src_servers = [cc._tag_to_ss[t] for t in src_team]
         dest_new = [cc._tag_to_ss[t] for t in dest_team if t not in src_team]
@@ -262,11 +288,36 @@ class DataDistributor:
                 for s in src_servers
             ]
             futs.append(d.start_fetch(begin, end, vm, refs))
-        await wait_all(futs)
+        try:
+            await wait_all(futs)
+            # the fetched data lives only in the destinations' overlays;
+            # the flip may only be persisted once it is durable there, or a
+            # power loss after the flip would strand the range on files the
+            # map no longer points at
+            vdone = max((d.version.get() for d in dest_new), default=vm)
+            for _ in range(600):
+                if all(d.durable_version >= vdone for d in dest_new):
+                    break
+                await self.loop.delay(0.25, TaskPriority.COORDINATION)
+            else:
+                raise TimedOut("destination durability never caught up")
+        except (TimedOut, BrokenPromise):
+            # a destination could not fetch (e.g. the whole source team
+            # died): cancel the stragglers (their buffering state must not
+            # shadow a later retry's), roll the map back to source-only —
+            # the extra boundaries stay, which is harmless — and report
+            # failure
+            for f in futs:
+                f.cancel()
+            while True:
+                v2 = await cc.install_storage_assignment(new_splits, src_only)
+                if v2 is not None:
+                    await cc.persist_key_servers(new_splits, src_only)
+                    return False
+                await self.loop.delay(0.1, TaskPriority.COORDINATION)
 
         # flip to the final map; a racing recovery re-recruits with the dual
         # map (harmless — both teams keep getting the data), so just retry
-        seg_idx = new_teams.index(dual)
         final_teams = [list(t) for t in new_teams]
         final_teams[seg_idx] = list(dest_team)
         while True:
@@ -274,6 +325,7 @@ class DataDistributor:
             if v2 is not None:
                 break
             await self.loop.delay(0.1, TaskPriority.COORDINATION)
+        await cc.persist_key_servers(new_splits, final_teams)
         self.moves += 1
         cc.trace.trace(
             "DDMoveComplete", Begin=repr(begin), End=repr(end),
@@ -285,7 +337,12 @@ class DataDistributor:
             # read-timeout window before discarding the source copy
             await self.loop.delay(1.5, TaskPriority.COORDINATION)
             for s in src_servers:
-                if s.tag not in dest_team and cc._tag_to_ss.get(s.tag) is s:
+                # re-check against the CURRENT map: a later move may have
+                # assigned (part of) the range back to this server
+                if (
+                    not self._tag_serves_overlap(s.tag, begin, end)
+                    and cc._tag_to_ss.get(s.tag) is s
+                ):
                     s.drop_range(begin, end)
 
         self._tasks.append(

@@ -395,8 +395,28 @@ class StorageServer:
         return task
 
     async def _fetch_keys(self, fs: _FetchState, sources: list[RequestStreamRef]) -> Version:
+        try:
+            return await self._fetch_keys_inner(fs, sources)
+        except BaseException:
+            # failed/cancelled fetch must not leave a stale buffering state
+            # behind (it would swallow this range's mutations forever), nor
+            # parked watches that no one will ever evaluate
+            if fs in self._fetching:
+                self._fetching.remove(fs)
+            for k in [k for k in self._watches if fs.begin <= k < fs.end_key]:
+                for _expected, req in self._watches.pop(k):
+                    req.reply_error(FutureVersion("shard fetch abandoned"))
+            raise
+
+    async def _fetch_keys_inner(self, fs: _FetchState, sources: list[RequestStreamRef]) -> Version:
         si = 0
+        attempts = 0
         while True:
+            attempts += 1
+            if attempts > 60:
+                # bounded: every source gone for many rounds — surface the
+                # failure so data distribution can roll the move back
+                raise TimedOut(f"fetchKeys [{fs.begin!r},{fs.end!r}) found no source")
             epoch = fs.epoch
             # snapshot at a version this server has already seen committed:
             # >= boundary so nothing between boundary and snapshot is missed
@@ -445,6 +465,13 @@ class StorageServer:
                 self.overlay.apply(version, m, self.store.get)
         self._fetching.remove(fs)
         self._range_floor.append((fs.begin, fs.end_key, snap_v))
+        # watches parked while the range was in flight (plus any registered
+        # before a move-in) are evaluated against the now-real data; a
+        # synthetic range "touch" reuses the normal fire logic
+        if self._watches:
+            self._fire_watches(
+                [Mutation(MutationType.CLEAR_RANGE, fs.begin, fs.end_key)]
+            )
 
     def drop_range(self, begin: bytes, end: bytes | None) -> None:
         """Discard [begin, end) (the source side after a completed move)."""
@@ -526,6 +553,11 @@ class StorageServer:
         while True:
             req = await self.watch_stream.next()
             r: WatchValueRequest = req.payload
+            if any(fs.covers(r.key) for fs in self._fetching):
+                # the key's data hasn't arrived yet (shard move): park the
+                # watch unevaluated; _finalize_fetch re-evaluates it
+                self._watches.setdefault(r.key, []).append((r.value, req))
+                continue
             current = self.overlay.get(r.key, self.version.get(), self.store.get)
             if current != r.value:
                 req.reply(self.version.get())  # already changed: fire now

@@ -177,6 +177,38 @@ def test_heal_dead_replica():
     c.stop()
 
 
+def test_move_survives_restart():
+    """The keyServers map is durable: after a move + power loss, the
+    restarted cluster routes the range to the destination team's files."""
+    c = RecoverableCluster(seed=207, n_storage_shards=2, storage_replication=2,
+                           durable=True)
+    db = c.database()
+    _put_many(c, db, 60)
+
+    dest = list(c.controller.storage_teams_tags[1])
+    moved = c.run_until(
+        c.loop.spawn(c.dd.move_range(b"k00020", b"k00040", dest)), 900
+    )
+    assert moved
+
+    async def settle():
+        await c.loop.delay(8.0)  # past the MVCC window: stores durable
+
+    c.run_until(c.loop.spawn(settle()), 600)
+    fs = c.power_off()
+    c2 = RecoverableCluster(seed=208, n_storage_shards=2,
+                            storage_replication=2, fs=fs, restart=True)
+    # the restarted controller recovered the moved map, not the convention
+    assert b"k00020" in c2.controller.storage_splits
+    seg = c2.controller.storage_splits.index(b"k00020") + 1
+    assert c2.controller.storage_teams_tags[seg] == dest
+    db2 = c2.database()
+    rows = _get_all(c2, db2)
+    assert len(rows) == 60
+    assert all(v == b"v%d" % i for i, (_k, v) in enumerate(rows))
+    c2.stop()
+
+
 def test_heal_durable_cluster_restart():
     """Heal on a durable cluster writes to the dead server's file lineage:
     a later power-off + restart recovers the healed data."""
