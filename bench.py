@@ -41,6 +41,7 @@ MAX_KEY_BYTES = 16
 KEY_POOL = 1 << 20
 WINDOW = PREFILL_BATCHES + TIMED_BATCHES + 2  # no GC mid-run: window covers it
 CAP = 1 << 19
+REC_CAP = 1 << 17  # LSM recent level: ~8 batches (2*8192 boundaries each)
 SEED = 20260729
 
 
@@ -270,8 +271,8 @@ def main() -> None:
         )
 
 
-def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str]:
-    """Pick the fastest (search_impl, merge_impl) pair ON THIS DEVICE.
+def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
+    """Pick the fastest (search_impl, merge_impl, lsm) combo ON THIS DEVICE.
 
     XLA's lowering quality for scatters/gathers vs sorts differs wildly
     across backends (TPU scatters serialize per row; sorts are tuned
@@ -288,16 +289,29 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str]:
 
         si = impl_from_env("search")
         mi = impl_from_env("merge")
-        print(f"[bench] autotune off: search={si} merge={mi}", file=sys.stderr)
-        return si, mi
+        lsm = os.environ.get("FDBTPU_LSM", "") == "1"
+        print(
+            f"[bench] autotune off: search={si} merge={mi} lsm={int(lsm)}",
+            file=sys.stderr,
+        )
+        return si, mi, lsm
 
-    combos = [("sort", "sort"), ("bucket", "scatter"), ("bucket", "sort")]
+    # (search_impl, merge_impl, lsm): lsm=True pays a rare O(CAP) compaction
+    # instead of a per-batch full-state merge — the merge phase dominates on
+    # TPU (52.8 of ~57ms/batch measured in r4), so it usually wins there
+    combos = [
+        ("sort", "sort", False),
+        ("bucket", "scatter", False),
+        ("bucket", "sort", False),
+        ("bucket", "sort", True),
+    ]
     results = {}
-    for si, mi in combos:
+    for si, mi, lsm in combos:
         try:
             dev = DeviceConflictSet(
                 max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
                 search_impl=si, merge_impl=mi,
+                lsm=lsm, recent_capacity=REC_CAP,
             )
             for b in prefill[:2]:
                 dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
@@ -314,19 +328,23 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str]:
                 dev.resolve_arrays(v, *args, sync=False)
             dev.check_pipelined()  # scalar fetch = completion barrier
             dt = time.perf_counter() - t0
-            results[(si, mi)] = dt
+            results[(si, mi, lsm)] = dt
             print(
-                f"[bench] autotune search={si:<6} merge={mi:<7}: "
+                f"[bench] autotune search={si:<6} merge={mi:<7} lsm={int(lsm)}: "
                 f"{dt * 1e3 / 2:.1f} ms/batch",
                 file=sys.stderr,
             )
         except Exception as e:  # noqa: BLE001 — a combo failing is data
-            print(f"[bench] autotune {si}/{mi} FAILED: {e!r}", file=sys.stderr)
+            print(f"[bench] autotune {si}/{mi}/lsm={int(lsm)} FAILED: {e!r}",
+                  file=sys.stderr)
     if not results:
-        return "sort", "sort"
-    (si, mi) = min(results, key=results.get)
-    print(f"[bench] autotune winner: search={si} merge={mi}", file=sys.stderr)
-    return si, mi
+        return "sort", "sort", True
+    (si, mi, lsm) = min(results, key=results.get)
+    print(
+        f"[bench] autotune winner: search={si} merge={mi} lsm={int(lsm)}",
+        file=sys.stderr,
+    )
+    return si, mi, lsm
 
 
 def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
@@ -335,12 +353,13 @@ def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet
 
-    search_impl, merge_impl = _autotune(backend, prefill, timed, pool_words)
+    search_impl, merge_impl, lsm = _autotune(backend, prefill, timed, pool_words)
 
     # ---------------- device ----------------
     dev = DeviceConflictSet(
         max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
         search_impl=search_impl, merge_impl=merge_impl,
+        lsm=lsm, recent_capacity=REC_CAP,
     )
     for b in prefill:
         dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))

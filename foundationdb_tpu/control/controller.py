@@ -509,7 +509,8 @@ class ClusterController:
                 TLog(p, self.loop, start_version=recovery_version + 1_000_000,
                      initial_tags=tlog_seeds[i],
                      known_committed=recovery_version,
-                     disk_queue=dq)
+                     disk_queue=dq,
+                     spill_bytes=self.knobs.TLOG_SPILL_BYTES)
             )
 
         resolvers: list[Resolver] = []

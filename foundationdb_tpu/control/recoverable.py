@@ -45,6 +45,10 @@ class RecoverableCluster:
                                 # run — flow/flow.h:65, Knobs.cpp:33-34).
                                 # Module-global: the newest cluster's setting
                                 # wins if two clusters are alive at once.
+        storage_engine: str = "memory",  # "memory" (KeyValueStoreMemory
+                                # analog: RAM + WAL) | "ssd" (append-only
+                                # COW B+tree, disk-bounded memory — the
+                                # configure(ssd) engine choice)
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -86,22 +90,33 @@ class RecoverableCluster:
         # storage servers persist across generations; each shard is served
         # by a TEAM of `storage_replication` servers, each with its own tag
         # (the reference's per-server Tag + keyServers teams)
+        if storage_engine not in ("memory", "ssd"):
+            raise ValueError(f"unknown storage_engine {storage_engine!r}")
+        self.storage_engine = storage_engine
+
+        def make_store(fname: str, p):
+            if self.fs is None:
+                return MemoryKeyValueStore()
+            if storage_engine == "ssd":
+                from ..storage.btree import BTreeKeyValueStore
+
+                cls_ = BTreeKeyValueStore
+            else:
+                from ..storage.kvstore import DurableMemoryKeyValueStore
+
+                cls_ = DurableMemoryKeyValueStore
+            return cls_.recover(self.fs, fname, p) if restart else cls_(self.fs, fname, p)
+
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
             for r in range(storage_replication):
                 p = self.net.create_process(f"storage-{i}r{r}")
-                if self.fs is not None:
-                    from ..storage.kvstore import DurableMemoryKeyValueStore
-
-                    fname = f"ss{i}r{r}.kv"
-                    if restart:
-                        store = DurableMemoryKeyValueStore.recover(self.fs, fname, p)
-                    else:
-                        store = DurableMemoryKeyValueStore(self.fs, fname, p)
-                    start_version = store.meta.get("durable_version", 0)
-                else:
-                    store = MemoryKeyValueStore()
-                    start_version = 0
+                store = make_store(f"ss{i}r{r}.kv", p)
+                start_version = (
+                    store.meta.get("durable_version", 0)
+                    if self.fs is not None
+                    else 0
+                )
                 # initial refs are dummies; the controller rewires on first recovery
                 self.storage.append(
                     StorageServer(
@@ -153,13 +168,16 @@ class RecoverableCluster:
             names, so the healed data must live there, and the dead file's
             durable prefix is a head start the snapshot fetch grounds over."""
             if self.fs is not None:
-                from ..storage.kvstore import DurableMemoryKeyValueStore
+                if self.storage_engine == "ssd":
+                    from ..storage.btree import BTreeKeyValueStore as cls_
+                else:
+                    from ..storage.kvstore import DurableMemoryKeyValueStore as cls_
 
                 shard, rep = ClusterController._parse_tag(tag)
                 path = f"ss{shard}r{rep}.kv"
-                if self.fs.exists(path):
-                    return DurableMemoryKeyValueStore.recover(self.fs, path, proc)
-                return DurableMemoryKeyValueStore(self.fs, path, proc)
+                if self.fs.exists(path if self.storage_engine != "ssd" else path + ".hdr"):
+                    return cls_.recover(self.fs, path, proc)
+                return cls_(self.fs, path, proc)
             return MemoryKeyValueStore()
 
         self.dd = DataDistributor(

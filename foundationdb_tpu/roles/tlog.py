@@ -86,7 +86,8 @@ class TLog:
                  start_version: Version = 0, sync_delay: float = 0.0005,
                  initial_tags: dict | None = None,
                  known_committed: Version = 0,
-                 disk_queue=None) -> None:
+                 disk_queue=None,
+                 spill_bytes: int = 1 << 22) -> None:
         self.loop = loop
         self.process = process
         self.sync_delay = sync_delay
@@ -98,7 +99,31 @@ class TLog:
         # per-tag: sorted list of (version, [Mutation]); popped prefix removed
         self._tags: dict[str, list[tuple[Version, list]]] = dict(initial_tags or {})
         self.dq = disk_queue  # storage.diskqueue.DiskQueue or None (memory)
-        self._live_bytes = 0
+        # -- spill (TLogServer spilled-data path, TLogServer.actor.cpp
+        # LogData::persistentData): when in-memory bytes exceed spill_bytes,
+        # a lagging tag's OLDEST entries drop their payloads and keep only
+        # (version, diskqueue offset, nbytes) — peeks re-read them from the
+        # durable log on demand, so a slow storage server bounds TLog RAM,
+        # not cluster data volume.  offset -1 = unspillable (the entry's
+        # payload lives only inside a RESET blob: seeds, recovery, rewrite).
+        self.spill_bytes = spill_bytes
+
+        def _nbytes(muts) -> int:
+            return sum(len(m.key) + len(m.value or b"") for m in muts)
+
+        # seeds carry real byte counts so the pop-side accounting (which
+        # subtracts the aligned _mem_offs entries) stays exact
+        self._mem_offs: dict[str, list[tuple[Version, int, int]]] = {
+            tag: [(v, -1, _nbytes(m)) for v, m in entries]
+            for tag, entries in self._tags.items()
+        }
+        self._spilled: dict[str, list[tuple[Version, int, int]]] = {}
+        seed_bytes = sum(
+            n for offs in self._mem_offs.values() for _v, _o, n in offs
+        )
+        self._live_bytes = seed_bytes
+        self._mem_bytes = seed_bytes
+        self.spill_events = 0
         if self.dq is not None:
             # frame the starting state; durable after initial_durable()/first
             # commit sync.  Callers must not delete the data's previous home
@@ -142,9 +167,10 @@ class TLog:
         # Sync BEFORE publishing: peek/lock must never serve data that was
         # not acked durable, or storage applies versions above the eventual
         # recovery version (phantom mutations of UNKNOWN-result txns).
+        rec_off = -1
         if self.dq is not None:
             w = BinaryWriter().u8(_R_COMMIT).i64(r.known_committed)
-            self.dq.push(
+            rec_off = self.dq.push(
                 w.data() + encode_version_mutations(r.version, r.mutations_by_tag)
             )
             await self.dq.sync()  # the fsync (group-commits buffered peers)
@@ -157,10 +183,48 @@ class TLog:
             return
         for tag, muts in r.mutations_by_tag.items():
             self._tags.setdefault(tag, []).append((r.version, muts))
-            self._live_bytes += sum(len(m.key) + len(m.value or b"") for m in muts)
+            nb = sum(len(m.key) + len(m.value or b"") for m in muts)
+            self._mem_offs.setdefault(tag, []).append((r.version, rec_off, nb))
+            self._live_bytes += nb
+            self._mem_bytes += nb
         self.version.set(r.version)
         self.known_committed = max(self.known_committed, r.known_committed)
+        if self.dq is not None and self._mem_bytes > self.spill_bytes:
+            self._spill()
         req.reply(r.version)
+
+    def _spill(self) -> None:
+        """Evict the heaviest tag's oldest spillable payloads until memory
+        is back under the limit (or nothing spillable remains)."""
+        while self._mem_bytes > self.spill_bytes:
+            best, best_bytes = None, 0
+            for tag, offs in self._mem_offs.items():
+                b = sum(n for _v, o, n in offs if o >= 0)
+                if b > best_bytes:
+                    best, best_bytes = tag, b
+            if best is None or best_bytes == 0:
+                return
+            q, offs = self._tags[best], self._mem_offs[best]
+            # spill the older half of the spillable suffix
+            first = next(i for i, (_v, o, _n) in enumerate(offs) if o >= 0)
+            take = max((len(offs) - first + 1) // 2, 1)
+            spill = offs[first : first + take]
+            self._spilled.setdefault(best, []).extend(spill)
+            del q[first : first + take]
+            del offs[first : first + take]
+            self._mem_bytes -= sum(n for _v, _o, n in spill)
+            self.spill_events += 1
+
+    def _read_spilled(self, tag: str, entries) -> list[tuple[Version, list]]:
+        out = []
+        for v, off, _n in entries:
+            payload = self.dq.read_at(off)
+            # record layout: u8 type + i64 known_committed + version/mutations
+            assert payload[0] == _R_COMMIT
+            version, by_tag = decode_version_mutations(payload[9:])
+            assert version == v
+            out.append((v, by_tag.get(tag, [])))
+        return out
 
     # -- peek --------------------------------------------------------------
     async def _serve_peek(self) -> None:
@@ -171,8 +235,33 @@ class TLog:
             i = bisect.bisect_left(q, r.begin_version, key=lambda e: e[0])
             # rare short reads exercise the storage re-peek path
             lim = 1 if buggify("tlog.peek_truncate") else 1000
-            entries = q[i : i + lim]
-            truncated = i + lim < len(q)
+            sp = self._spilled.get(r.tag, [])
+            if not sp:
+                entries = q[i : i + lim]
+                truncated = i + lim < len(q)
+            else:
+                # merge in-memory and spilled entries by version (seeds may
+                # predate the spilled range, so neither list dominates)
+                si = bisect.bisect_left(sp, r.begin_version, key=lambda e: e[0])
+                mem_take: list = []
+                sp_take: list = []
+                order: list = []
+                qi = i
+                while len(order) < lim and (si < len(sp) or qi < len(q)):
+                    if si < len(sp) and (qi >= len(q) or sp[si][0] < q[qi][0]):
+                        order.append((True, len(sp_take)))
+                        sp_take.append(sp[si])
+                        si += 1
+                    else:
+                        order.append((False, len(mem_take)))
+                        mem_take.append(q[qi])
+                        qi += 1
+                decoded = self._read_spilled(r.tag, sp_take)
+                entries = [
+                    decoded[idx] if is_sp else mem_take[idx]
+                    for is_sp, idx in order
+                ]
+                truncated = si < len(sp) or qi < len(q)
             # on truncation, end_version must not skip unfetched entries
             end = entries[-1][0] + 1 if truncated else self.version.get() + 1
             req.reply(
@@ -194,12 +283,18 @@ class TLog:
             q = self._tags.get(r.tag, [])
             i = bisect.bisect_right(q, r.upto_version, key=lambda e: e[0])
             if i:
-                self._live_bytes -= sum(
-                    len(m.key) + len(m.value or b"")
-                    for _v, muts in q[:i]
-                    for m in muts
-                )
+                offs = self._mem_offs.get(r.tag, [])
+                freed = sum(n for _v, _o, n in offs[:i])
+                self._live_bytes -= freed
+                self._mem_bytes -= freed
                 self._tags[r.tag] = q[i:]
+                self._mem_offs[r.tag] = offs[i:]
+            sp = self._spilled.get(r.tag)
+            if sp:
+                j = bisect.bisect_right(sp, r.upto_version, key=lambda e: e[0])
+                if j:
+                    self._live_bytes -= sum(n for _v, _o, n in sp[:j])
+                    self._spilled[r.tag] = sp[j:]
             if self.dq is not None:
                 # lazily durable: a lost POP record only means re-serving
                 # already-durable data after a crash (storage dedups by
@@ -207,7 +302,14 @@ class TLog:
                 self.dq.push(
                     BinaryWriter().u8(_R_POP).str_(r.tag).i64(r.upto_version).data()
                 )
-                if self.dq.bytes_pushed > 4 * max(self._live_bytes, 1) + (1 << 20):
+                if (
+                    self.dq.bytes_pushed > 4 * max(self._live_bytes, 1) + (1 << 20)
+                    and not any(self._spilled.values())
+                ):
+                    # a rewrite invalidates every recorded record offset, so
+                    # it only runs with nothing spilled, and the surviving
+                    # in-memory entries become unspillable (their payloads
+                    # now live only inside the fresh RESET blob)
                     self.dq.rewrite(
                         [
                             _encode_reset(
@@ -215,6 +317,10 @@ class TLog:
                             )
                         ]
                     )
+                    self._mem_offs = {
+                        tag: [(v, -1, n) for v, _o, n in offs]
+                        for tag, offs in self._mem_offs.items()
+                    }
             req.reply(None)
 
     # -- lock (recovery) ----------------------------------------------------
@@ -223,8 +329,16 @@ class TLog:
             req = await self.lock_stream.next()
             assert isinstance(req.payload, TLogLockRequest)
             self.locked = True
+            tags = {tag: list(q) for tag, q in self._tags.items()}
+            # recovery must see spilled entries too: re-read and merge them
+            # in version order (a transient memory spike, once, at lock)
+            for tag, sp in self._spilled.items():
+                if sp:
+                    merged = self._read_spilled(tag, sp) + tags.get(tag, [])
+                    merged.sort(key=lambda e: e[0])
+                    tags[tag] = merged
             req.reply(
-                TLogLockReply(end_version=self.version.get(), tags=dict(self._tags))
+                TLogLockReply(end_version=self.version.get(), tags=tags)
             )
 
     # -- confirm (GRV liveness) ---------------------------------------------
@@ -280,7 +394,7 @@ class TLog:
             for q in self._tags.values()
             for _v, muts in q
             for m in muts
-        )
+        ) + sum(n for sp in self._spilled.values() for _v, _o, n in sp)
 
     def stop(self) -> None:
         for t in self._tasks:

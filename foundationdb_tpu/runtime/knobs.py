@@ -80,6 +80,10 @@ class CoreKnobs(Knobs):
         # data distribution (DataDistribution.actor.cpp): storage failure
         # ping cadence, shard-size poll cadence, and the split threshold
         # (the reference splits on byte size via StorageMetrics; we count keys)
+        # TLog in-memory budget before lagging tags spill payloads to the
+        # disk queue (TLogServer spilled-data; TLOG_SPILL_THRESHOLD analog)
+        self.init("TLOG_SPILL_BYTES", 1 << 22)
+
         self.init("DD_PING_INTERVAL", 0.25)
         self.init("DD_SPLIT_INTERVAL", 0.5)
         self.init("DD_SHARD_SPLIT_KEYS", 100_000)

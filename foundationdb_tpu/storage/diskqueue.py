@@ -34,10 +34,27 @@ class DiskQueue:
         self.bytes_pushed = 0
 
     # -- write path ---------------------------------------------------------
-    def push(self, payload: bytes) -> None:
+    def push(self, payload: bytes) -> int:
+        """Append one framed record; returns its file offset (the TLog's
+        spill index records it to re-read entries evicted from memory)."""
+        off = self.file.size()
         crc = zlib.crc32(payload) & 0xFFFFFFFF
         self.file.append(_HEADER.pack(_MAGIC, len(payload), crc) + payload)
         self.bytes_pushed += len(payload)
+        return off
+
+    def read_at(self, off: int) -> bytes:
+        """Re-read one record by the offset push() returned (spilled-entry
+        fetch).  Offsets are invalidated by rewrite() — callers must not
+        hold them across a rewrite."""
+        head = self.file.pread(off, _HEADER.size)
+        if len(head) < _HEADER.size:
+            raise IOError(f"diskqueue short read at {off}")
+        magic, ln, crc = _HEADER.unpack(head)
+        payload = self.file.pread(off + _HEADER.size, ln)
+        if magic != _MAGIC or len(payload) != ln or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise IOError(f"diskqueue record corrupt at {off}")
+        return payload
 
     async def sync(self) -> None:
         await self.file.sync()

@@ -78,6 +78,33 @@ class SimFile:
         self._st.pending_truncate = True
 
     # -- read path ----------------------------------------------------------
+    def pread(self, offset: int, length: int) -> bytes:
+        """Positional read of the current contents (same-process view) —
+        the IAsyncFile::read analog the paged B-tree engine and the TLog
+        spill path use.  O(length + unsynced chunks), never a full copy."""
+        st = self._st
+        parts: list[bytes] = []
+        pos, need = offset, length
+        base = 0 if st.pending_truncate else len(st.synced)
+        if pos < base and need > 0:
+            take = min(need, base - pos)
+            parts.append(bytes(st.synced[pos : pos + take]))
+            pos += take
+            need -= take
+        chunk_start = base
+        for chunk in st.unsynced:
+            if need <= 0:
+                break
+            chunk_end = chunk_start + len(chunk)
+            if pos < chunk_end:
+                s = pos - chunk_start
+                take = min(need, len(chunk) - s)
+                parts.append(chunk[s : s + take])
+                pos += take
+                need -= take
+            chunk_start = chunk_end
+        return b"".join(parts)
+
     def read_all(self) -> bytes:
         """Contents as a same-process reader sees them (pending ops applied)."""
         out = bytearray() if self._st.pending_truncate else bytearray(self._st.synced)

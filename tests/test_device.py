@@ -286,3 +286,83 @@ def test_merge_impl_parity_scatter_vs_sort():
 
     assert np.array_equal(np.asarray(a._ks), np.asarray(b._ks))
     assert np.array_equal(np.asarray(a._vs), np.asarray(b._vs))
+
+
+# ---------------------------------------------------------------------------
+# LSM (two-level) state: the TPU-fast path — per-batch merges go into a
+# small recent level, compactions fold it into main (device.py
+# resolve_core_lsm / compact_lsm).
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_lsm_randomized_parity(seed):
+    """LSM twin of the randomized parity suite, with a tiny recent level so
+    compactions (and main regrowth) happen constantly mid-stream."""
+    rng = random.Random(1000 + seed)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(capacity=1 << 8, lsm=True, recent_capacity=64)
+    version = 0
+    for _ in range(25):
+        version += rng.randrange(1, 8)
+        txns = _rand_batch(rng, version, oracle.oldest_version, rng.randrange(1, 14))
+        want = oracle.resolve_batch(version, txns)
+        got = dev.resolve_batch(version, txns)
+        assert got == want, f"seed={seed} version={version}"
+        if rng.random() < 0.3:
+            floor = rng.randrange(version + 1)
+            oracle.remove_before(floor)
+            dev.remove_before(floor)
+    # fold whatever recent holds and check parity still holds afterwards
+    dev._compact()
+    version += 1
+    txns = _rand_batch(rng, version, oracle.oldest_version, 8)
+    assert oracle.resolve_batch(version, txns) == dev.resolve_batch(version, txns)
+
+
+def test_lsm_pipelined_parity_with_compactions():
+    """sync=False streaming through compactions: deferred checks stay green
+    and verdicts match the oracle batch-for-batch."""
+    import numpy as np
+
+    from foundationdb_tpu.conflict.device import pack_batch
+
+    rng = random.Random(77)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(capacity=1 << 9, lsm=True, recent_capacity=128)
+    version = 0
+    pending = []
+    for i in range(40):
+        version += rng.randrange(1, 5)
+        txns = _rand_batch(rng, version, oracle.oldest_version, rng.randrange(1, 10))
+        want = oracle.resolve_batch(version, txns)
+        packed = pack_batch(txns, dev._oldest, dev._offset, dev._max_key_bytes)
+        got_dev = dev.resolve_arrays(version, *packed[:8], sync=False)
+        pending.append((got_dev, want, len(txns)))
+        if i % 13 == 12:
+            dev.check_pipelined()
+    dev.check_pipelined()
+    for got_dev, want, B in pending:
+        got = [Verdict(int(c)) for c in np.asarray(got_dev)[:B]]
+        assert got == want
+
+
+def test_lsm_gc_clamps_all_levels():
+    """remove_before must clamp main, its cached RMQ table, and recent —
+    a read below the new floor is TOO_OLD, and history semantics survive."""
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(capacity=1 << 8, lsm=True, recent_capacity=64)
+    for v, key in [(5, b"a"), (10, b"b"), (15, b"c")]:
+        txns = [TxInfo(read_snapshot=v - 1, read_ranges=[],
+                       write_ranges=[(key, key + b"\x00")])]
+        assert oracle.resolve_batch(v, txns) == dev.resolve_batch(v, txns)
+    oracle.remove_before(8)
+    dev.remove_before(8)
+    txns = [
+        # snapshot below floor: TOO_OLD
+        TxInfo(read_snapshot=7, read_ranges=[(b"a", b"b")], write_ranges=[]),
+        # reads b (written at 10 > snap 9): conflict
+        TxInfo(read_snapshot=9, read_ranges=[(b"b", b"b\x00")], write_ranges=[]),
+        # reads a (clamped history, snap 9 >= floor): commits
+        TxInfo(read_snapshot=9, read_ranges=[(b"a", b"a\x00")], write_ranges=[]),
+    ]
+    assert oracle.resolve_batch(20, txns) == dev.resolve_batch(20, txns)
