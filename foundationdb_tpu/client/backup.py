@@ -1,0 +1,198 @@
+"""Backup agent + restore — the fdbbackup / fdbrestore surface
+(fdbclient/FileBackupAgent.actor.cpp; bin equivalents fdbbackup/backup.actor.cpp).
+
+A backup is two artifacts in a container (a prefix inside a filesystem):
+
+  log.dq        the FULL mutation stream from the backup's start boundary,
+                written continuously by the BackupWorker (roles/backup.py)
+  snapshot.dq   chunked range reads, each chunk = (begin, end, version,
+                rows) taken at its own read version (a long snapshot never
+                needs one giant MVCC window — same as the reference's
+                chunked key-range dumps)
+
+Restorable once every chunk's range is covered and the log reaches
+max(chunk versions).  restore() applies the chunks, then replays log
+mutations — clipped so a mutation only applies where its version exceeds
+the covering chunk's version (the chunk already reflects older ones; the
+reference restore applies the same version-range filter per range)."""
+
+from __future__ import annotations
+
+import bisect
+
+from ..roles.backup import BackupWorker, decode_log_frame
+from ..roles.types import Mutation, MutationType
+from ..runtime.core import TaskPriority
+from ..runtime.serialize import BinaryReader, BinaryWriter
+from ..storage.diskqueue import DiskQueue
+
+
+class BackupContainer:
+    """One backup's files under `prefix` in a (sim) filesystem."""
+
+    def __init__(self, fs, prefix: str, process=None) -> None:
+        self.fs = fs
+        self.prefix = prefix
+        self.log_dq = DiskQueue(fs.open(f"{prefix}-log.dq", process))
+        self.snap_dq = DiskQueue(fs.open(f"{prefix}-snapshot.dq", process))
+
+
+def _encode_chunk(begin: bytes, end: bytes, version: int, rows) -> bytes:
+    w = BinaryWriter().bytes_(begin).bytes_(end).i64(version).u32(len(rows))
+    for k, v in rows:
+        w.bytes_(k).bytes_(v)
+    return w.data()
+
+
+def _decode_chunk(buf: bytes):
+    r = BinaryReader(buf)
+    begin, end, version = r.bytes_(), r.bytes_(), r.i64()
+    rows = [(r.bytes_(), r.bytes_()) for _ in range(r.u32())]
+    return begin, end, version, rows
+
+
+class BackupAgent:
+    """Drives one backup of a RecoverableCluster into a container."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        self.worker: BackupWorker | None = None
+        self.start_version: int | None = None
+
+    async def start(self, container: BackupContainer) -> int:
+        """Begin continuous mutation-log capture; returns the boundary
+        version (log complete above it)."""
+        cc = self.cluster.controller
+        proc = self.cluster.net.create_process("backup-worker")
+        # starting below the boundary is safe: the backup tag has no entries
+        # before it, so the first peek fast-forwards the cursor
+        w = BackupWorker(proc, self.cluster.loop, container.log_dq, start_version=0)
+        while True:
+            vm = await cc.enable_backup(w)
+            if vm is not None:
+                self.worker = w
+                self.start_version = vm
+                return vm
+            await self.cluster.loop.delay(0.1, TaskPriority.COORDINATION)
+
+    async def snapshot(self, container: BackupContainer, chunk_rows: int = 500) -> int:
+        """Chunked full-range dump; returns the max chunk version (the
+        backup is restorable once the log passes it)."""
+        db = self.cluster.database()
+        cursor = b""
+        max_v = self.start_version or 0
+        from ..keys import key_after
+
+        while True:
+            # user range only ([\x00, \xff)): the system keyspace describes
+            # THIS cluster's configuration and must not be restored into
+            # another (the reference's default backup range)
+            tr = db.create_transaction()
+            rows = await tr.get_range(cursor, b"\xff", limit=chunk_rows,
+                                      snapshot=True)
+            v = await tr.get_read_version()
+            end = key_after(rows[-1][0]) if len(rows) == chunk_rows else b"\xff"
+            container.snap_dq.push(_encode_chunk(cursor, end, v, rows))
+            max_v = max(max_v, v)
+            if len(rows) < chunk_rows:
+                break
+            cursor = end
+        await container.snap_dq.sync()
+        return max_v
+
+    async def wait_backed_up_to(self, version: int, timeout: float = 60.0) -> None:
+        from ..runtime.combinators import timeout_error
+
+        await timeout_error(
+            self.cluster.loop, self.worker.backed_up.when_at_least(version), timeout
+        )
+
+    async def stop(self) -> None:
+        try:
+            await self.cluster.controller.disable_backup()
+        finally:
+            if self.worker is not None:
+                self.worker.stop()
+                self.worker = None
+
+
+def read_backup(container: BackupContainer):
+    """Parse a container → (chunks, log) for restore/inspection."""
+    chunks = [_decode_chunk(b) for b in container.snap_dq.recover()]
+    log = [decode_log_frame(b) for b in container.log_dq.recover()]
+    log.sort(key=lambda e: e[0])
+    return chunks, log
+
+
+async def restore(db, container: BackupContainer, target_version: int | None = None,
+                  batch: int = 300) -> int:
+    """Restore a backup into an (empty-range) database.  Applies snapshot
+    chunks, then replays the mutation log where version > the covering
+    chunk's version, up to target_version (default: everything captured).
+    Returns the version the restored state corresponds to."""
+    chunks, log = read_backup(container)
+    if not chunks:
+        raise ValueError("backup has no snapshot")
+    # chunk version step function over keyspace (chunks are disjoint)
+    chunks.sort(key=lambda c: c[0])
+    bounds = [c[0] for c in chunks]
+    cvers = [c[2] for c in chunks]
+    restorable_from = max(cvers)
+    if target_version is None:
+        target_version = log[-1][0] if log else restorable_from
+    if target_version < restorable_from:
+        raise ValueError(
+            f"target {target_version} below newest chunk {restorable_from}"
+        )
+
+    def chunk_version_at(key: bytes) -> int:
+        i = bisect.bisect_right(bounds, key) - 1
+        return cvers[i] if i >= 0 else 0
+
+    # 1. snapshot chunks, batched transactions
+    pending: list[tuple[bytes, bytes]] = []
+    for _b, _e, _v, rows in chunks:
+        pending.extend(rows)
+    for i in range(0, len(pending), batch):
+        part = pending[i : i + batch]
+
+        async def fn(tr, part=part):
+            for k, v in part:
+                tr.set(k, v)
+
+        await db.run(fn)
+
+    # 2. log replay, clipped per chunk version
+    ops: list[Mutation] = []
+    for version, muts in log:
+        if version > target_version:
+            break
+        for m in muts:
+            if m.type == MutationType.CLEAR_RANGE:
+                # user range only, split at chunk boundaries; keep parts
+                # where the chunk predates the clear
+                ce = min(m.value, b"\xff")
+                if m.key >= ce:
+                    continue
+                pts = [m.key] + [b for b in bounds if m.key < b < ce] + [ce]
+                for lo, hi in zip(pts, pts[1:]):
+                    if version > chunk_version_at(lo):
+                        ops.append(Mutation(MutationType.CLEAR_RANGE, lo, hi))
+            elif m.key >= b"\xff":
+                continue  # system keyspace: not part of the backup
+            elif version > chunk_version_at(m.key):
+                ops.append(m)
+    for i in range(0, len(ops), batch):
+        part = ops[i : i + batch]
+
+        async def fn(tr, part=part):
+            for m in part:
+                if m.type == MutationType.SET_VALUE:
+                    tr.set(m.key, m.value)
+                elif m.type == MutationType.CLEAR_RANGE:
+                    tr.clear_range(m.key, m.value)
+                else:
+                    tr.atomic_op(m.type, m.key, m.value)
+
+        await db.run(fn)
+    return target_version

@@ -42,6 +42,7 @@ from ..roles.types import (
     ResolutionSplitRequest,
     TLogLockReply,
     TLogLockRequest,
+    TLogPopRequest,
     Version,
 )
 from ..rpc.network import Endpoint, SimNetwork, SimProcess
@@ -132,6 +133,7 @@ class ClusterController:
         self.resolver_moves = 0
         self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
+        self.backup_worker = None  # BackupWorker while a backup is running
         self.views: list[ClusterView] = []
         self.recovery_state = RecoveryState.READING_CSTATE
         self._recovering = False
@@ -270,9 +272,13 @@ class ClusterController:
         self, alive: list[TLogLockReply], recovery_version: Version
     ) -> list[dict]:
         """Rebuild per-new-tlog tag seeds from surviving replicas."""
+        from ..roles.backup import BACKUP_TAG
+
         merged: dict[str, list] = {}
         for r in alive:
             for tag, entries in r.tags.items():
+                if tag == BACKUP_TAG and self.backup_worker is None:
+                    continue  # residue of a finished backup: drop, not seed
                 cur = merged.setdefault(tag, [])
                 have = {v for v, _ in cur}
                 cur.extend((v, m) for v, m in entries if v not in have)
@@ -375,6 +381,86 @@ class ClusterController:
         assert old.tag == new.tag
         self._tag_to_ss[new.tag] = new
         self.storage[self.storage.index(old)] = new
+
+    # -- backup (FileBackupAgent enable/disable + worker wiring) -------------
+    async def enable_backup(self, worker) -> Version | None:
+        """Tag every future commit with the backup tag and wire the worker
+        to this generation's TLogs.  Returns the boundary version: the
+        mutation log is complete from it onward.  None = recovery raced or
+        the commit plane would not drain (caller retries)."""
+        from ..roles.backup import BACKUP_TAG
+
+        if self.backup_worker is not None:
+            raise RuntimeError("a backup is already running (one backup tag)")
+        gen = self.generation
+        if gen is None or self._recovering:
+            return None
+        for p in gen.proxies:
+            p.pause_commits()
+        try:
+            try:
+                await self._wait_commit_drain(gen)
+            except TimedOut:
+                return None
+            if gen is not self.generation or self._recovering:
+                return None
+            for p in gen.proxies:
+                p.tag_to_tlogs = {**p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)}
+                p.backup_tag = BACKUP_TAG
+            self.backup_worker = worker
+            self._wire_backup(gen)
+            return gen.sequencer._last_assigned
+        finally:
+            for p in gen.proxies:
+                p.resume_commits()
+
+    async def disable_backup(self) -> None:
+        from ..roles.backup import BACKUP_TAG
+
+        # cleared FIRST: a recovery racing anything below recruits its new
+        # generation without the backup tag
+        self.backup_worker = None
+        gen = self.generation
+        if gen is None:
+            return
+        for p in gen.proxies:
+            p.pause_commits()
+        try:
+            try:
+                await self._wait_commit_drain(gen)
+            except TimedOut:
+                pass  # clearing the tag un-drained only strands a few
+                      # residual entries — the pops below reclaim them
+            gen = self.generation  # a recovery may have swapped it (the new
+            if gen is None:        # generation is already backup-free)
+                return
+            for p in gen.proxies:
+                p.backup_tag = None
+        finally:
+            for p in (gen.proxies if gen else []):
+                p.resume_commits()
+        # reclaim the tag's TLog space: residual entries would otherwise be
+        # retained (and re-seeded at every recovery) forever
+        upto = gen.sequencer._last_assigned + (1 << 40)
+        cc = self._cc_proc()
+        for t in gen.tlogs:
+            RequestStreamRef(self.net, cc, t.pop_stream.endpoint).send(
+                TLogPopRequest(BACKUP_TAG, upto)
+            )
+
+    def _wire_backup(self, gen: GenerationRoles) -> None:
+        from ..roles.backup import BACKUP_TAG
+
+        w = self.backup_worker
+        slots = self._tag_tlogs(BACKUP_TAG)
+        tlog = gen.tlogs[slots[0]]
+        w.set_tlog_source(
+            RequestStreamRef(self.net, w.process, tlog.peek_stream.endpoint),
+            [
+                RequestStreamRef(self.net, w.process, gen.tlogs[s].pop_stream.endpoint)
+                for s in slots
+            ],
+        )
 
     # -- keyServers persistence (data distribution across restarts) ---------
     def _keyservers_dq(self):
@@ -557,6 +643,16 @@ class ClusterController:
             proxy.ratekeeper = self.ratekeeper
             proxy.on_commit_failure = self._on_proxy_failure
             proxies.append(proxy)
+        if self.backup_worker is not None:
+            # an active backup survives generations: the new proxies keep
+            # tagging the full stream (the worker rejoins by tag in _rewire)
+            from ..roles.backup import BACKUP_TAG
+
+            for p in proxies:
+                p.tag_to_tlogs = {
+                    **p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)
+                }
+                p.backup_tag = BACKUP_TAG
         # mutual raw-version refs: each proxy's GRV takes the max over all
         # proxies' committed versions (getLiveCommittedVersion :1002)
         for p in proxies:
@@ -583,6 +679,8 @@ class ClusterController:
                 RequestStreamRef(self.net, ss.process, tlog.pop_stream.endpoint),
                 recovery_version=recovery_version,
             )
+        if self.backup_worker is not None:
+            self._wire_backup(gen)
         for view in self.views:
             self._fill_view(view)
 

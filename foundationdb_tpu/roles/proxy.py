@@ -120,6 +120,7 @@ class CommitProxy:
         self.tag_to_tlogs = tag_to_tlogs or {
             t: [0] for team in storage_tags.members for t in team
         }
+        self.backup_tag: str | None = None  # set while a backup is running
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
         self.name = process.name
@@ -368,6 +369,11 @@ class CommitProxy:
                 for team in teams:
                     for tag in team:
                         by_tag.setdefault(tag, []).append(m)
+                if self.backup_tag is not None:
+                    # backup workers subscribe to the FULL mutation stream
+                    # via their own tag (the reference's backup workers pull
+                    # txsTag'd backup mutations the same way)
+                    by_tag.setdefault(self.backup_tag, []).append(m)
         # every TLog sees every version (its prev->version chain must advance
         # even on empty batches) but only stores its own tags' mutations
         per_tlog: list[dict[str, list[Mutation]]] = [dict() for _ in self.tlogs]
