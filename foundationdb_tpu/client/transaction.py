@@ -32,6 +32,7 @@ from ..roles.types import (
 )
 from ..rpc.stream import RequestStreamRef
 from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TimedOut
+from ..runtime.trace import g_trace_batch
 from ..keys import key_after
 
 # errors a client retry loop may transparently retry (the onError set,
@@ -95,6 +96,10 @@ class Database:
         self.loop = loop
         self.view = view
         self._rng = rng.split()
+        # fraction of transactions given a pipeline-timeline debug ID
+        # (g_traceBatch; the reference samples via CLIENT_KNOBS->
+        # *_DEBUG_TRANSACTION_RATE)
+        self.debug_sample_rate = 0.0
 
     @property
     def _grv(self) -> RequestStreamRef:
@@ -109,7 +114,11 @@ class Database:
         return self.view.smap
 
     def create_transaction(self) -> "Transaction":
-        return Transaction(self)
+        tr = Transaction(self)
+        if self.debug_sample_rate > 0 and self._rng.random() < self.debug_sample_rate:
+            tr.debug_id = self._rng.random_unique_id()[:12]
+            g_trace_batch.add("NativeAPI.createTransaction", tr.debug_id)
+        return tr
 
     def create_ryw_transaction(self):
         """A read-your-writes transaction (the reference's default client
@@ -176,6 +185,7 @@ class Transaction:
         self._write_ranges: list[tuple[bytes, bytes]] = []
         self.committed_version: Version | None = None
         self._backoff = 0.01  # carried across on_error resets
+        self.debug_id: str | None = None  # set by sampled create_transaction
 
     def reset(self) -> None:
         """Clear all transaction state for a retry (fresh read version,
@@ -247,10 +257,16 @@ class Transaction:
     # -- read version -------------------------------------------------------
     async def get_read_version(self) -> Version:
         if self._read_version is None:
+            g_trace_batch.add(
+                "NativeAPI.getConsistentReadVersion.Before", self.debug_id
+            )
             reply = await self._reply_rerouted(
-                lambda: self.db._grv, GetReadVersionRequest()
+                lambda: self.db._grv, GetReadVersionRequest(debug_id=self.debug_id)
             )
             self._read_version = reply.version
+            g_trace_batch.add(
+                "NativeAPI.getConsistentReadVersion.After", self.debug_id
+            )
         return self._read_version
 
     # -- reads --------------------------------------------------------------
@@ -259,12 +275,14 @@ class Transaction:
         # loadBalance (fdbrpc/LoadBalance.actor.h:159): pick a random replica
         # of the shard's team per attempt; _reply_rerouted re-picks on a
         # dead endpoint, so reads fail over to the surviving replicas
+        g_trace_batch.add("NativeAPI.getValue.Before", self.debug_id)
         reply = await self._reply_rerouted(
             lambda: self.db._rng.random_choice(
                 self.db._smap.member_for_key(key)
             )["getvalue"],
-            GetValueRequest(key, v),
+            GetValueRequest(key, v, debug_id=self.debug_id),
         )
+        g_trace_batch.add("NativeAPI.getValue.After", self.debug_id)
         if not snapshot:
             self._read_ranges.append((key, key_after(key)))
         return reply.value
@@ -336,9 +354,12 @@ class Transaction:
             read_conflict_ranges=list(self._read_ranges),
             write_conflict_ranges=list(self._write_ranges),
             mutations=list(self._mutations),
+            debug_id=self.debug_id,
         )
+        g_trace_batch.add("NativeAPI.commit.Before", self.debug_id)
         try:
             reply: CommitReply = await self.db._commit.get_reply(req, timeout=5.0)
+            g_trace_batch.add("NativeAPI.commit.After", self.debug_id)
         except TimedOut:
             # proxy unreachable: the commit may have happened
             raise CommitUnknownResult()

@@ -45,6 +45,9 @@ class SimCluster:
         self.rng = DeterministicRandom(seed)
         self.knobs = knobs or CoreKnobs()
         self.trace = TraceCollector(clock=self.loop.now)
+        from .runtime.trace import g_trace_batch
+
+        g_trace_batch.attach_clock(self.loop.now)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         make_cs = conflict_backend or OracleConflictSet
 

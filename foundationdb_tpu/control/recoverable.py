@@ -61,6 +61,9 @@ class RecoverableCluster:
             _buggify.disable()
             self.knobs = knobs or CoreKnobs()
         self.trace = TraceCollector(clock=self.loop.now)
+        from ..runtime.trace import g_trace_batch
+
+        g_trace_batch.attach_clock(self.loop.now)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))
         self.fs = None

@@ -59,7 +59,7 @@ def cluster_status(cluster) -> dict[str, Any]:
         ],
         "tlogs": [
             {"version": t.version.get(), "bytes_queued": t.bytes_queued,
-             "locked": t.locked}
+             "locked": t.locked, "spill_events": getattr(t, "spill_events", 0)}
             for t in tlogs
         ],
         "storage": [
@@ -72,4 +72,85 @@ def cluster_status(cluster) -> dict[str, Any]:
             for ss in cluster.storage
         ],
     }
+    dd = getattr(cluster, "dd", None)
+    if dd is not None:
+        doc["cluster"]["data_distribution"] = {
+            "moves": dd.moves,
+            "heals": dd.heals,
+            "shard_splits": dd.shard_splits,
+            "shards": len(controller.storage_teams_tags),
+        }
+    if controller is not None:
+        doc["cluster"]["backup_running"] = controller.backup_worker is not None
+    if loop.profile:
+        doc["profiler"] = {
+            "busy_s_by_priority": dict(loop.busy_s_by_priority),
+            "slow_tasks": len(loop.slow_tasks),
+        }
     return doc
+
+
+# -- status schema (fdbclient/Schemas.cpp + tests/status/* goldens) ----------
+#
+# A field spec is: a type (isinstance check), a dict (required keys,
+# recursed), a [spec] (list, every element validated), or a tuple of
+# accepted types.  Optional keys are suffixed '?'.
+
+STATUS_SCHEMA: dict = {
+    "cluster": {
+        "generation": {"state": str, "epoch": int, "count": int},
+        "clock": (int, float),
+        "messages_sent": int,
+        "messages_dropped": int,
+        "processes": dict,
+        "latest_events": dict,
+        "data_distribution?": {
+            "moves": int, "heals": int, "shard_splits": int, "shards": int,
+        },
+        "backup_running?": bool,
+    },
+    "proxy": {
+        "committed_version": int,
+        "batch_interval": (int, float),
+        "txns_committed": int,
+        "txns_conflicted": int,
+        "commit_batches": int,
+        "mvcc_window_throttles": int,
+    },
+    "resolvers": [{"version": int, "oldest_version": int}],
+    "tlogs": [
+        {"version": int, "bytes_queued": int, "locked": bool, "spill_events": int}
+    ],
+    "storage": [
+        {"tag": str, "version": int, "durable_version": int, "keys": int}
+    ],
+    "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
+}
+
+
+def validate_status(doc, schema=None, path: str = "status") -> None:
+    """Raise ValueError where `doc` violates the schema — the analog of the
+    reference's schema-checked status (Status.actor.cpp checks emitted docs
+    against Schemas.cpp in simulation)."""
+    schema = STATUS_SCHEMA if schema is None else schema
+    if isinstance(schema, dict):
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: expected object, got {type(doc).__name__}")
+        for key, sub in schema.items():
+            optional = key.endswith("?")
+            k = key[:-1] if optional else key
+            if k not in doc:
+                if optional:
+                    continue
+                raise ValueError(f"{path}.{k}: missing")
+            validate_status(doc[k], sub, f"{path}.{k}")
+    elif isinstance(schema, list):
+        if not isinstance(doc, list):
+            raise ValueError(f"{path}: expected array, got {type(doc).__name__}")
+        for i, item in enumerate(doc):
+            validate_status(item, schema[0], f"{path}[{i}]")
+    else:
+        if not isinstance(doc, schema):
+            raise ValueError(
+                f"{path}: expected {schema}, got {type(doc).__name__}"
+            )

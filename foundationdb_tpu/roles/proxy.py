@@ -44,7 +44,7 @@ from ..runtime.combinators import wait_all, wait_any
 from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.buggify import buggify, maybe_delay
-from ..runtime.trace import CounterCollection
+from ..runtime.trace import CounterCollection, g_trace_batch
 
 
 class KeyPartitionMap:
@@ -281,6 +281,12 @@ class CommitProxy:
         self.c_batches.add(1)
         deadline = self.loop.now() + self.knobs.COMMIT_PATH_GIVEUP
         self._req_num += 1
+        # sampled debug IDs only (usually none): the station loops below
+        # must cost nothing on the un-sampled hot path
+        dbg = [pc.request.debug_id for pc in batch
+               if pc.request.debug_id is not None]
+        for d in dbg:
+            g_trace_batch.add("CommitProxyServer.commitBatch.Before", d)
         gv: GetCommitVersionReply = await self._retry_reply(
             self.sequencer,
             GetCommitVersionRequest(
@@ -289,6 +295,8 @@ class CommitProxy:
             deadline,
         )
         prev_v, version = gv.prev_version, gv.version
+        for d in dbg:
+            g_trace_batch.add("CommitProxyServer.commitBatch.GotCommitVersion", d)
 
         # phase 2: per-resolver range split (ResolutionRequestBuilder :242)
         # using the partition map effective at THIS batch's version
@@ -328,6 +336,8 @@ class CommitProxy:
             Verdict(min(int(rep.committed[i]) for rep in replies))
             for i in range(len(batch))
         ]
+        for d in dbg:
+            g_trace_batch.add("CommitProxyServer.commitBatch.AfterResolution", d)
 
         # phase 4 precondition — the versions-in-flight commit throttle
         # (:850-870): the semi-committed span (this batch's version minus the
@@ -408,6 +418,8 @@ class CommitProxy:
         # TEST at :943).
         if self.committed_version.get() < version:
             self.committed_version.set(version)
+        for d in dbg:
+            g_trace_batch.add("CommitProxyServer.commitBatch.AfterLogPush", d)
         for pc, v in zip(batch, verdicts):
             if v == Verdict.COMMITTED:
                 self.c_committed.add(1)
@@ -539,6 +551,10 @@ class CommitProxy:
             await maybe_delay(self.loop, "proxy.delay_grv")
             version = self.committed_version.get()
             for r in reqs:
+                g_trace_batch.add(
+                    "GrvProxyServer.transactionStarter.AskLiveCommittedVersion",
+                    getattr(r.payload, "debug_id", None),
+                )
                 r.reply(GetReadVersionReply(version))
 
     def stop(self) -> None:

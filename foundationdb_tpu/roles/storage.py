@@ -38,6 +38,7 @@ from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
+from ..runtime.trace import g_trace_batch
 from ..runtime.knobs import CoreKnobs
 
 
@@ -534,6 +535,7 @@ class StorageServer:
 
     async def _getvalue_one(self, req) -> None:
         r: GetValueRequest = req.payload
+        g_trace_batch.add("StorageServer.getValue.Received", r.debug_id)
         await maybe_delay(self.loop, "storage.delay_read")
         try:
             await self._wait_version(r.version)
@@ -547,6 +549,7 @@ class StorageServer:
             req.reply_error(e)
             return
         req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
+        g_trace_batch.add("StorageServer.getValue.Replied", r.debug_id)
 
     # -- watches (storageserver watch futures) -------------------------------
     async def _serve_watch(self) -> None:

@@ -99,6 +99,7 @@ class CommitTransactionRequest:
     read_conflict_ranges: list[tuple[bytes, bytes]]
     write_conflict_ranges: list[tuple[bytes, bytes]]
     mutations: list[Mutation]
+    debug_id: str | None = None  # sampled pipeline-timeline ID (g_traceBatch)
 
 
 class CommitResult(enum.Enum):
@@ -257,7 +258,7 @@ class ClusterRecovering(Exception):
 
 @dataclasses.dataclass
 class GetReadVersionRequest:
-    pass
+    debug_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -272,6 +273,7 @@ class GetReadVersionReply:
 class GetValueRequest:
     key: bytes
     version: Version
+    debug_id: str | None = None
 
 
 @dataclasses.dataclass

@@ -304,6 +304,13 @@ class EventLoop:
         self._seq = 0
         self._stopped = False
         self.tasks_run = 0
+        # flow-profiler analog (the reference's --profiler / slow-task
+        # sampler): when enabled, wall-clock busy time accumulates per task
+        # priority and steps slower than slow_task_threshold are recorded
+        self.profile = False
+        self.slow_task_threshold = 0.05
+        self.busy_s_by_priority: dict[int, float] = {}
+        self.slow_tasks: list[tuple[float, int, float]] = []  # (t, pri, dur)
 
     # -- time --------------------------------------------------------------
     def now(self) -> float:
@@ -347,7 +354,16 @@ class EventLoop:
         if when > self._now:
             self._now = when
         self.tasks_run += 1
+        if not self.profile:
+            fn()
+            return True
+        t0 = _time.perf_counter()
         fn()
+        dur = _time.perf_counter() - t0
+        pri = -negpri
+        self.busy_s_by_priority[pri] = self.busy_s_by_priority.get(pri, 0.0) + dur
+        if dur >= self.slow_task_threshold and len(self.slow_tasks) < 10_000:
+            self.slow_tasks.append((self._now, pri, dur))
         return True
 
     def run_until(self, fut: Future, deadline: float | None = None) -> Any:

@@ -48,6 +48,55 @@ class TraceCollector:
         return len(self.find(event_type)) + self._suppressed.get(event_type, 0)
 
 
+class TraceBatch:
+    """Per-transaction pipeline timelines — the g_traceBatch analog
+    (flow/Trace.h:253; the reference emits TransactionDebug/CommitDebug
+    events keyed by a sampled debug ID at every pipeline station, and tools
+    reconstruct a transaction's journey by joining on the ID).
+
+    A module global, exactly like the reference's: role code at any layer
+    calls `g_trace_batch.add(location, debug_id)` without plumbing a
+    collector through every constructor.  The newest cluster attaches its
+    clock; tests read `timeline(debug_id)`."""
+
+    def __init__(self) -> None:
+        self.events: list[dict[str, Any]] = []
+        self.suppressed = 0
+        self._clock: Callable[[], float] = lambda: 0.0
+        self._keep = 100_000
+
+    def attach_clock(self, clock: Callable[[], float]) -> None:
+        """Bind the newest cluster's clock AND start a fresh event log: two
+        same-seed clusters derive identical debug IDs, so carrying events
+        across would interleave different runs under one ID (and pin the
+        previous cluster's loop in memory via the old clock closure)."""
+        self._clock = clock
+        self.clear()
+
+    def add(self, location: str, debug_id: str | None) -> None:
+        if debug_id is None:
+            return
+        if len(self.events) < self._keep:
+            self.events.append(
+                {"Time": self._clock(), "Location": location, "ID": debug_id}
+            )
+        else:
+            self.suppressed += 1
+
+    def timeline(self, debug_id: str) -> list[dict[str, Any]]:
+        return sorted(
+            (e for e in self.events if e["ID"] == debug_id),
+            key=lambda e: e["Time"],
+        )
+
+    def clear(self) -> None:
+        self.events = []
+        self.suppressed = 0
+
+
+g_trace_batch = TraceBatch()
+
+
 class Counter:
     __slots__ = ("name", "value")
 

@@ -1,0 +1,116 @@
+"""Observability: per-transaction pipeline timelines (g_traceBatch analog),
+the flow-profiler analog, and the schema-checked status document
+(flow/Trace.h:253; fdbclient/Schemas.cpp; the reference profiler)."""
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.control.status import cluster_status, validate_status
+from foundationdb_tpu.runtime.trace import g_trace_batch
+
+
+def test_transaction_timeline_covers_pipeline_stations():
+    """A sampled transaction's debug ID is traceable through client GRV,
+    commit-proxy batch phases, and storage reads, in causal order."""
+    c = RecoverableCluster(seed=601, n_storage_shards=1, storage_replication=2)
+    g_trace_batch.clear()
+    db = c.database()
+    db.debug_sample_rate = 1.0
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"obs", b"1")
+        await tr.commit()
+        tr2 = db.create_transaction()
+        val = await tr2.get(b"obs")
+        return tr.debug_id, tr2.debug_id, val
+
+    cid, rid, val = c.run_until(c.loop.spawn(main()), 300)
+    assert val == b"1"
+    assert cid is not None and rid is not None and cid != rid
+
+    commit_locs = [e["Location"] for e in g_trace_batch.timeline(cid)]
+    for want in [
+        "NativeAPI.createTransaction",
+        "NativeAPI.getConsistentReadVersion.Before",
+        "GrvProxyServer.transactionStarter.AskLiveCommittedVersion",
+        "NativeAPI.getConsistentReadVersion.After",
+        "NativeAPI.commit.Before",
+        "CommitProxyServer.commitBatch.Before",
+        "CommitProxyServer.commitBatch.GotCommitVersion",
+        "CommitProxyServer.commitBatch.AfterResolution",
+        "CommitProxyServer.commitBatch.AfterLogPush",
+        "NativeAPI.commit.After",
+    ]:
+        assert want in commit_locs, f"missing {want}: {commit_locs}"
+    # causal order within the commit path
+    order = [commit_locs.index(x) for x in (
+        "NativeAPI.commit.Before",
+        "CommitProxyServer.commitBatch.GotCommitVersion",
+        "CommitProxyServer.commitBatch.AfterResolution",
+        "CommitProxyServer.commitBatch.AfterLogPush",
+        "NativeAPI.commit.After",
+    )]
+    assert order == sorted(order)
+
+    read_locs = [e["Location"] for e in g_trace_batch.timeline(rid)]
+    for want in [
+        "NativeAPI.getValue.Before",
+        "StorageServer.getValue.Received",
+        "StorageServer.getValue.Replied",
+        "NativeAPI.getValue.After",
+    ]:
+        assert want in read_locs, f"missing {want}: {read_locs}"
+    c.stop()
+
+
+def test_unsampled_transactions_emit_nothing():
+    c = RecoverableCluster(seed=602, n_storage_shards=1, storage_replication=2)
+    g_trace_batch.clear()
+    db = c.database()  # debug_sample_rate defaults to 0
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"q", b"1")
+        await tr.commit()
+        return tr.debug_id
+
+    assert c.run_until(c.loop.spawn(main()), 300) is None
+    assert g_trace_batch.events == []
+    c.stop()
+
+
+def test_status_document_matches_schema():
+    c = RecoverableCluster(seed=603, n_storage_shards=2, storage_replication=2)
+    c.loop.profile = True
+    db = c.database()
+
+    async def main():
+        for i in range(10):
+            tr = db.create_transaction()
+            tr.set(b"s%02d" % i, b"v")
+            await tr.commit()
+
+    c.run_until(c.loop.spawn(main()), 300)
+    doc = cluster_status(c)
+    validate_status(doc)  # raises on any schema violation
+    assert doc["proxy"]["txns_committed"] >= 1
+    assert doc["cluster"]["data_distribution"]["shards"] == 2
+    assert doc["cluster"]["backup_running"] is False
+    assert doc["profiler"]["busy_s_by_priority"]  # profiler accumulated
+    c.stop()
+
+
+def test_profiler_accumulates_busy_time():
+    c = RecoverableCluster(seed=604, n_storage_shards=1, storage_replication=2)
+    c.loop.profile = True
+    db = c.database()
+
+    async def main():
+        for i in range(20):
+            tr = db.create_transaction()
+            tr.set(b"p%02d" % i, b"v")
+            await tr.commit()
+
+    c.run_until(c.loop.spawn(main()), 300)
+    assert sum(c.loop.busy_s_by_priority.values()) > 0
+    assert len(c.loop.busy_s_by_priority) > 1  # multiple priorities ran
+    c.stop()
