@@ -101,6 +101,8 @@ class ClusterController:
         cstate=None,  # CoordinatedState or None (tests without coordinators)
         fs=None,      # SimFilesystem: TLogs become disk-backed
         restart: bool = False,  # bootstrap generation 1 from on-disk TLogs
+        machines: list[tuple[str, str]] | None = None,  # (name, dc) ring for
+                                # role placement (sim2 machine model)
     ) -> None:
         self.loop = loop
         self.net = net
@@ -123,6 +125,7 @@ class ClusterController:
         self.cstate = cstate
         self.fs = fs
         self.restart = restart
+        self.machines = machines or []
         if restart and fs is not None and fs.exists(self.KEYSERVERS_PATH):
             # data distribution moved shards in a previous life: the on-disk
             # keyServers map, not the tag naming convention, says where the
@@ -148,9 +151,24 @@ class ClusterController:
         )
 
     # -- process pool -------------------------------------------------------
-    def _new_proc(self, role: str) -> SimProcess:
+    def _new_proc(self, role: str, spread: tuple[int, int] | None = None) -> SimProcess:
+        """spread=(i, n): place the i-th of n same-kind roles evenly across
+        the machine ring — TLog/proxy replicas must straddle DCs, or one
+        DC's loss takes every copy (the reference's recruitment policies,
+        ReplicationPolicy Across(dcid))."""
         self._proc_seq += 1
-        return self.net.create_process(f"{role}-e{self.epoch}-{self._proc_seq}")
+        extra = {}
+        if self.machines:
+            if spread is not None:
+                i, n = spread
+                idx = (i * len(self.machines)) // max(n, 1)
+            else:
+                idx = self._proc_seq
+            m, d = self.machines[idx % len(self.machines)]
+            extra = {"machine": m, "dc": d}
+        return self.net.create_process(
+            f"{role}-e{self.epoch}-{self._proc_seq}", **extra
+        )
 
     # -- bootstrap ----------------------------------------------------------
     async def start(self) -> None:
@@ -251,12 +269,25 @@ class ClusterController:
         if old is None:
             return 0, [dict() for _ in range(self.n_tlogs)]
         replies: list[TLogLockReply | None] = []
-        for t in old.tlogs:
+        for i, t in enumerate(old.tlogs):
             ref = RequestStreamRef(self.net, self._cc_proc(), t.lock_stream.endpoint)
             try:
                 replies.append(await ref.get_reply(TLogLockRequest(), timeout=1.0))
+                continue
             except (TimedOut, BrokenPromise):
-                replies.append(None)  # that TLog is gone
+                pass
+            # a KILLED TLog's disk outlives it (kill drops only the unsynced
+            # suffix, and every acked commit was synced first): recover its
+            # state from the file — the difference between "machine died"
+            # and "data lost".  Only for observably-dead processes: an alive
+            # but partitioned TLog must not be bypassed (it could still be
+            # acking; the lock fence is what stops it).
+            if self.fs is not None and not t.process.alive:
+                reply = self._read_tlog_file(self._tlog_path(i, old.epoch))
+                if reply is not None:
+                    replies.append(reply)
+                    continue
+            replies.append(None)  # that TLog is gone
         alive = [r for r in replies if r is not None]
         if not alive:
             raise RuntimeError("all TLogs lost: unrecoverable data loss")
@@ -294,6 +325,18 @@ class ClusterController:
     def _tlog_path(self, slot: int, epoch: int) -> str:
         return f"tlog{slot}-e{epoch}.dq"
 
+    def _read_tlog_file(self, path: str) -> TLogLockReply | None:
+        """Recover one TLog's state from its synced log file (shared by the
+        whole-cluster restart path and the live-recovery fallback for
+        observably-dead TLogs)."""
+        if not self.fs.exists(path):
+            return None
+        from ..storage.diskqueue import DiskQueue
+
+        dq = DiskQueue(self.fs.open(path, None))
+        end, _kc, tags = TLog.recover_state(dq)
+        return TLogLockReply(end_version=end, tags=tags)
+
     def _recover_tlogs_from_disk(self, prev_epoch: int, prev_n_tlogs: int):
         """Whole-cluster restart: rebuild (recovery_version, seeds) from the
         previous epoch's synced TLog files.  Unsynced suffixes died with the
@@ -304,17 +347,10 @@ class ClusterController:
         write), not the new config's — restarting with fewer TLog slots must
         still replay every old slot's file or tags whose replica pair lived
         in the dropped slots would be silently lost."""
-        from ..storage.diskqueue import DiskQueue
-
-        replies = []
-        for i in range(prev_n_tlogs):
-            path = self._tlog_path(i, prev_epoch)
-            if not self.fs.exists(path):
-                replies.append(None)
-                continue
-            dq = DiskQueue(self.fs.open(path, None))
-            end, _kc, tags = TLog.recover_state(dq)
-            replies.append(TLogLockReply(end_version=end, tags=tags))
+        replies = [
+            self._read_tlog_file(self._tlog_path(i, prev_epoch))
+            for i in range(prev_n_tlogs)
+        ]
         alive = [r for r in replies if r is not None]
         if not alive:
             raise RuntimeError("no TLog files recovered: data loss")
@@ -583,7 +619,7 @@ class ClusterController:
 
         tlogs: list[TLog] = []
         for i in range(self.n_tlogs):
-            p = self._new_proc(f"tlog{i}")
+            p = self._new_proc(f"tlog{i}", spread=(i, self.n_tlogs))
             procs.append(p)
             add_ping(p)
             dq = None
@@ -617,7 +653,7 @@ class ClusterController:
         all_tags = [t for team in tag_teams for t in team]
         proxies: list[CommitProxy] = []
         for i in range(self.n_proxies):
-            proxy_proc = self._new_proc(f"proxy{i}")
+            proxy_proc = self._new_proc(f"proxy{i}", spread=(i, self.n_proxies))
             procs.append(proxy_proc)
             add_ping(proxy_proc)
             proxy = CommitProxy(

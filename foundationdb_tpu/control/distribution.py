@@ -124,7 +124,29 @@ class DataDistributor:
                 ranges.append((bounds[i], bounds[i + 1], srcs))
         self._heal_seq += 1
         dead.stop()  # before reopening its store file: no straggler writes
-        proc = self.net.create_process(f"storage-heal{self._heal_seq}-{tag}")
+        extra = {}
+        if cc.machines:
+            # replica-spread policy: avoid the dead machine AND every
+            # surviving teammate's machine (preferring their DCs excluded
+            # too), or the team collapses onto one failure domain
+            survivor_m = {
+                cc._tag_to_ss[t].process.machine
+                for _b, _e, ts in ranges for t in ts
+            }
+            survivor_d = {
+                cc._tag_to_ss[t].process.dc
+                for _b, _e, ts in ranges for t in ts
+            }
+            forbidden = survivor_m | {getattr(dead.process, "machine", None)}
+            ring = [
+                m for m in cc.machines
+                if m[0] not in forbidden and m[1] not in survivor_d
+            ] or [m for m in cc.machines if m[0] not in forbidden] or cc.machines
+            m, d = ring[self._heal_seq % len(ring)]
+            extra = {"machine": m, "dc": d}
+        proc = self.net.create_process(
+            f"storage-heal{self._heal_seq}-{tag}", **extra
+        )
         store = self.store_factory(tag, proc)
         gen = cc.generation
         tlog = gen.tlogs[cc._tag_tlogs(tag)[0]]

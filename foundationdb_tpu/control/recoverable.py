@@ -49,6 +49,11 @@ class RecoverableCluster:
                                 # analog: RAM + WAL) | "ssd" (append-only
                                 # COW B+tree, disk-bounded memory — the
                                 # configure(ssd) engine choice)
+        n_machines: int = 0,    # >0: machine/DC topology (sim2 machine
+                                # model) — roles spread over machines,
+                                # replicas placed across machines AND DCs,
+                                # correlated kills via net.kill_machine/_dc
+        n_dcs: int = 2,         # DC labels when n_machines > 0
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -79,13 +84,47 @@ class RecoverableCluster:
         def splits(n: int) -> list[bytes]:
             return [bytes([256 * i // n]) for i in range(1, n)]
 
+        # machine/DC ring: machine m{i} lives in dc{i * n_dcs // n_machines}
+        # (the first half of the machines in dc0, second in dc1, ...), so
+        # the replica offset below places a team's copies in DIFFERENT DCs
+        self.machines: list[tuple[str, str]] = [
+            (f"m{i}", f"dc{i * n_dcs // n_machines}") for i in range(n_machines)
+        ]
+
+        def mach_spread(i: int, n: int) -> dict:
+            """i-th of n same-kind roles, spread evenly over the ring (the
+            coordinator quorum must straddle DCs like TLogs do)."""
+            if not self.machines:
+                return {}
+            m, d = self.machines[(i * len(self.machines)) // max(n, 1) % len(self.machines)]
+            return {"machine": m, "dc": d}
+
+        by_dc: dict[str, list[str]] = {}
+        for m, d in self.machines:
+            by_dc.setdefault(d, []).append(m)
+        dc_names = sorted(by_dc)
+
+        def mach_replica(shard: int, r: int) -> dict:
+            """Replica r of a shard goes to DC (r mod n_dcs), cycling
+            machines within it — replicas are in DIFFERENT DCs whenever
+            replication <= n_dcs, and on different machines regardless
+            (exact for any ring size, unlike a fixed machine offset)."""
+            if not self.machines:
+                return {}
+            d = dc_names[r % len(dc_names)]
+            ring = by_dc[d]
+            m = ring[(shard + r // len(dc_names)) % len(ring)]
+            return {"machine": m, "dc": d}
+
         self._initial_storage_splits = splits(n_storage_shards)
         resolver_splits = splits(n_resolvers)
 
         self.coordinators = [
             Coordinator(
-                self.net.create_process(f"coord-{i}"), self.loop,
-                fs=self.fs, path=f"coord{i}.reg",
+                self.net.create_process(
+                    f"coord-{i}", **mach_spread(i, n_coordinators)
+                ),
+                self.loop, fs=self.fs, path=f"coord{i}.reg",
             )
             for i in range(n_coordinators)
         ]
@@ -113,7 +152,9 @@ class RecoverableCluster:
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
             for r in range(storage_replication):
-                p = self.net.create_process(f"storage-{i}r{r}")
+                p = self.net.create_process(
+                    f"storage-{i}r{r}", **mach_replica(i, r)
+                )
                 store = make_store(f"ss{i}r{r}.kv", p)
                 start_version = (
                     store.meta.get("durable_version", 0)
@@ -148,6 +189,7 @@ class RecoverableCluster:
             cstate=cstate,
             fs=self.fs,
             restart=restart,
+            machines=self.machines,
         )
         self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
         from .ratekeeper import Ratekeeper

@@ -77,11 +77,16 @@ class EndpointTable:
 
 
 class SimProcess(EndpointTable):
-    """A simulated process: endpoint table + lifecycle (ISimulator::ProcessInfo)."""
+    """A simulated process: endpoint table + lifecycle (ISimulator::ProcessInfo).
+    `machine`/`dc` are locality labels (ISimulator machine/data-hall model,
+    fdbrpc/sim2.actor.cpp:714): killing a machine kills every process on it."""
 
-    def __init__(self, net: "SimNetwork", address: NetworkAddress, name: str) -> None:
+    def __init__(self, net: "SimNetwork", address: NetworkAddress, name: str,
+                 machine: str | None = None, dc: str | None = None) -> None:
         super().__init__(address, name)
         self.net = net
+        self.machine = machine
+        self.dc = dc
         self.reboots = 0
         self.on_death: list[Promise] = []
 
@@ -138,15 +143,36 @@ class SimNetwork:
         self.messages_dropped = 0
 
     # -- topology ----------------------------------------------------------
-    def create_process(self, name: str, ip: str | None = None, port: int = 4500) -> SimProcess:
+    def create_process(self, name: str, ip: str | None = None, port: int = 4500,
+                       machine: str | None = None, dc: str | None = None) -> SimProcess:
         if ip is None:
             ip = f"1.0.0.{len(self.processes) + 1}"
         addr = NetworkAddress(ip, port)
         if addr in self.processes:
             raise ValueError(f"address {addr} in use")
-        proc = SimProcess(self, addr, name)
+        proc = SimProcess(self, addr, name, machine=machine, dc=dc)
         self.processes[addr] = proc
         return proc
+
+    def machine_processes(self, machine: str) -> list[SimProcess]:
+        return [p for p in self.processes.values() if p.machine == machine]
+
+    def kill_machine(self, machine: str) -> list[SimProcess]:
+        """Correlated failure: every process on the machine dies at once
+        (the reference's machine kills, sim2.actor.cpp killMachine)."""
+        victims = [p for p in self.machine_processes(machine) if p.alive]
+        for p in victims:
+            p.kill()
+        self.trace.trace("KillMachine", Machine=machine, Procs=len(victims))
+        return victims
+
+    def kill_dc(self, dc: str) -> list[SimProcess]:
+        """Data-center loss: every process with the dc label dies."""
+        victims = [p for p in self.processes.values() if p.dc == dc and p.alive]
+        for p in victims:
+            p.kill()
+        self.trace.trace("KillDataCenter", DC=dc, Procs=len(victims))
+        return victims
 
     # -- faults ------------------------------------------------------------
     def clog_pair(self, a: NetworkAddress, b: NetworkAddress, seconds: float) -> None:

@@ -1,0 +1,61 @@
+"""Increment workload — atomic-op exactly-once accounting
+(fdbserver/workloads/Increment.actor.cpp + AtomicOps.actor.cpp: concurrent
+ADDs whose grand total must equal the committed op count exactly; any
+double-apply from a mishandled commit_unknown_result shows up as a sum
+mismatch)."""
+
+from __future__ import annotations
+
+from .base import Workload
+from ..roles.types import MutationType
+
+
+class IncrementWorkload(Workload):
+    description = "Increment"
+
+    def __init__(self, counters: int = 4, clients: int = 3,
+                 adds_per_client: int = 10, delta: int = 3):
+        self.counters = counters
+        self.clients = clients
+        self.adds = adds_per_client
+        self.delta = delta
+        self.committed = 0
+
+    def _key(self, i: int) -> bytes:
+        return b"incr/%02d" % i
+
+    async def start(self, cluster, rng) -> None:
+        db = cluster.database()
+
+        async def client(crng):
+            for _ in range(self.adds):
+                idx = crng.random_int(0, self.counters)
+
+                async def fn(tr, idx=idx):
+                    tr.atomic_op(
+                        MutationType.ADD, self._key(idx),
+                        self.delta.to_bytes(8, "little"),
+                    )
+
+                # db.run's unknown-result fence makes the retry exactly-once
+                await db.run(fn)
+                self.committed += 1
+
+        from ..runtime.combinators import wait_all
+
+        await wait_all(
+            [cluster.loop.spawn(client(rng.split())) for _ in range(self.clients)]
+        )
+
+    async def check(self, cluster, rng) -> bool:
+        db = cluster.database()
+
+        async def fn(tr):
+            return await tr.get_range(b"incr/", b"incr0", limit=1000)
+
+        rows = await db.run(fn)
+        total = sum(int.from_bytes(v[:8], "little") for _k, v in rows)
+        return total == self.committed * self.delta
+
+    def metrics(self) -> dict:
+        return {"committed": self.committed}
