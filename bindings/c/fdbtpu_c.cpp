@@ -1,0 +1,272 @@
+/* fdbtpu C client — blocking stub speaking the gateway's length-prefixed
+ * binary protocol (foundationdb_tpu/tools/gateway.py; the fdb_c.cpp slot,
+ * reference bindings/c/fdb_c.cpp:85-293).
+ *
+ * The reference links the entire native client into the caller; this
+ * client keeps transactions server-side (read-your-writes objects in the
+ * gateway) and the wire protocol language-neutral — the same .so serves C,
+ * and any FFI-capable language (see bindings/python/fdbtpu_ctypes.py).
+ *
+ * One socket per database handle; requests are serialized on it (simple
+ * blocking request/reply — a request id is carried for future pipelining).
+ */
+#include "fdbtpu_c.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+struct FDBTPU_Database {
+  int fd;
+  uint64_t next_req;
+};
+
+/* ---- little-endian buffer helpers ---- */
+static void put_u32(uint8_t *p, uint32_t v) { memcpy(p, &v, 4); }
+static void put_u64(uint8_t *p, uint64_t v) { memcpy(p, &v, 8); }
+static void put_i64(uint8_t *p, int64_t v) { memcpy(p, &v, 8); }
+static uint32_t get_u32(const uint8_t *p) { uint32_t v; memcpy(&v, p, 4); return v; }
+static uint64_t get_u64(const uint8_t *p) { uint64_t v; memcpy(&v, p, 8); return v; }
+static int64_t get_i64(const uint8_t *p) { int64_t v; memcpy(&v, p, 8); return v; }
+
+static int write_all(int fd, const uint8_t *buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = write(fd, buf, n);
+    if (w <= 0) return -1;
+    buf += w;
+    n -= (size_t)w;
+  }
+  return 0;
+}
+
+static int read_all(int fd, uint8_t *buf, size_t n) {
+  while (n > 0) {
+    ssize_t r = read(fd, buf, n);
+    if (r <= 0) return -1;
+    buf += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+/* ---- request/reply ----
+ * body is the op payload AFTER (req_id, op).  On success *out is a
+ * malloc'd reply body (may be NULL when empty) and the status is
+ * returned. */
+static int rpc(FDBTPU_Database *db, uint8_t op, const uint8_t *body,
+               uint32_t body_len, uint8_t **out, uint32_t *out_len) {
+  uint64_t req = ++db->next_req;
+  uint32_t flen = 8 + 1 + body_len;
+  uint8_t hdr[4 + 8 + 1];
+  put_u32(hdr, flen);
+  put_u64(hdr + 4, req);
+  hdr[12] = op;
+  if (write_all(db->fd, hdr, sizeof hdr) != 0) return -1;
+  if (body_len && write_all(db->fd, body, body_len) != 0) return -1;
+
+  uint8_t rl[4];
+  if (read_all(db->fd, rl, 4) != 0) return -1;
+  uint32_t rlen = get_u32(rl);
+  if (rlen < 9) return -1;
+  uint8_t *rbuf = (uint8_t *)malloc(rlen);
+  if (!rbuf) return -1;
+  if (read_all(db->fd, rbuf, rlen) != 0) { free(rbuf); return -1; }
+  if (get_u64(rbuf) != req) { free(rbuf); return -1; } /* no pipelining yet */
+  int status = rbuf[8];
+  if (out) {
+    *out_len = rlen - 9;
+    if (*out_len) {
+      *out = (uint8_t *)malloc(*out_len);
+      memcpy(*out, rbuf + 9, *out_len);
+    } else {
+      *out = NULL;
+    }
+  }
+  free(rbuf);
+  return status;
+}
+
+FDBTPU_Database *fdbtpu_open(const char *host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return NULL;
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &sa.sin_addr) != 1 ||
+      connect(fd, (struct sockaddr *)&sa, sizeof sa) != 0) {
+    close(fd);
+    return NULL;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  FDBTPU_Database *db = (FDBTPU_Database *)calloc(1, sizeof(FDBTPU_Database));
+  db->fd = fd;
+  return db;
+}
+
+void fdbtpu_close(FDBTPU_Database *db) {
+  if (!db) return;
+  close(db->fd);
+  free(db);
+}
+
+int fdbtpu_txn_create(FDBTPU_Database *db, uint64_t *txn) {
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 1, NULL, 0, &out, &out_len);
+  if (st == 0 && out_len >= 8) *txn = get_u64(out);
+  free(out);
+  return st;
+}
+
+static int txn_only(FDBTPU_Database *db, uint8_t op, uint64_t txn) {
+  uint8_t body[8];
+  put_u64(body, txn);
+  return rpc(db, op, body, 8, NULL, NULL);
+}
+
+int fdbtpu_txn_destroy(FDBTPU_Database *db, uint64_t txn) {
+  return txn_only(db, 2, txn);
+}
+int fdbtpu_txn_reset(FDBTPU_Database *db, uint64_t txn) {
+  return txn_only(db, 3, txn);
+}
+
+int fdbtpu_txn_set(FDBTPU_Database *db, uint64_t txn, const uint8_t *key,
+                   uint32_t key_len, const uint8_t *val, uint32_t val_len) {
+  uint32_t blen = 8 + 4 + key_len + 4 + val_len;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, key_len);
+  memcpy(b + 12, key, key_len);
+  put_u32(b + 12 + key_len, val_len);
+  memcpy(b + 16 + key_len, val, val_len);
+  int st = rpc(db, 4, b, blen, NULL, NULL);
+  free(b);
+  return st;
+}
+
+int fdbtpu_txn_clear_range(FDBTPU_Database *db, uint64_t txn,
+                           const uint8_t *begin, uint32_t begin_len,
+                           const uint8_t *end, uint32_t end_len) {
+  uint32_t blen = 8 + 4 + begin_len + 4 + end_len;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, begin_len);
+  memcpy(b + 12, begin, begin_len);
+  put_u32(b + 12 + begin_len, end_len);
+  memcpy(b + 16 + begin_len, end, end_len);
+  int st = rpc(db, 5, b, blen, NULL, NULL);
+  free(b);
+  return st;
+}
+
+int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
+                          const uint8_t *key, uint32_t key_len, int64_t delta) {
+  uint32_t blen = 8 + 4 + key_len + 8;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, key_len);
+  memcpy(b + 12, key, key_len);
+  put_i64(b + 12 + key_len, delta);
+  int st = rpc(db, 10, b, blen, NULL, NULL);
+  free(b);
+  return st;
+}
+
+int fdbtpu_txn_get(FDBTPU_Database *db, uint64_t txn, const uint8_t *key,
+                   uint32_t key_len, int *present, uint8_t **val,
+                   uint32_t *val_len) {
+  uint32_t blen = 8 + 4 + key_len;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, key_len);
+  memcpy(b + 12, key, key_len);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 6, b, blen, &out, &out_len);
+  free(b);
+  *present = 0;
+  *val = NULL;
+  *val_len = 0;
+  if (st == 0 && out_len >= 5) {
+    *present = out[0];
+    uint32_t vlen = get_u32(out + 1);
+    if (*present && vlen <= out_len - 5) {
+      *val = (uint8_t *)malloc(vlen ? vlen : 1);
+      memcpy(*val, out + 5, vlen);
+      *val_len = vlen;
+    }
+  }
+  free(out);
+  return st;
+}
+
+int fdbtpu_txn_get_range(FDBTPU_Database *db, uint64_t txn,
+                         const uint8_t *begin, uint32_t begin_len,
+                         const uint8_t *end, uint32_t end_len, uint32_t limit,
+                         uint32_t *n_rows, uint8_t **blob, uint32_t *blob_len) {
+  uint32_t blen = 8 + 4 + begin_len + 4 + end_len + 4;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, begin_len);
+  memcpy(b + 12, begin, begin_len);
+  put_u32(b + 12 + begin_len, end_len);
+  memcpy(b + 16 + begin_len, end, end_len);
+  put_u32(b + 16 + begin_len + end_len, limit);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 7, b, blen, &out, &out_len);
+  free(b);
+  *n_rows = 0;
+  *blob = NULL;
+  *blob_len = 0;
+  if (st == 0 && out_len >= 4) {
+    *n_rows = get_u32(out);
+    *blob_len = out_len - 4;
+    if (*blob_len) {
+      *blob = (uint8_t *)malloc(*blob_len);
+      memcpy(*blob, out + 4, *blob_len);
+    }
+  }
+  free(out);
+  return st;
+}
+
+int fdbtpu_txn_commit(FDBTPU_Database *db, uint64_t txn, int64_t *version) {
+  uint8_t body[8];
+  put_u64(body, txn);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 8, body, 8, &out, &out_len);
+  if (st == 0 && out_len >= 8) *version = get_i64(out);
+  free(out);
+  return st;
+}
+
+int fdbtpu_txn_get_read_version(FDBTPU_Database *db, uint64_t txn,
+                                int64_t *version) {
+  uint8_t body[8];
+  put_u64(body, txn);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 11, body, 8, &out, &out_len);
+  if (st == 0 && out_len >= 8) *version = get_i64(out);
+  free(out);
+  return st;
+}
+
+int fdbtpu_txn_on_error(FDBTPU_Database *db, uint64_t txn, int code) {
+  if (code < 1 || code > 5) return code; /* not retryable */
+  uint8_t body[12];
+  put_u64(body, txn);
+  int32_t c = (int32_t)code;
+  memcpy(body + 8, &c, 4);
+  int st = rpc(db, 9, body, 12, NULL, NULL);
+  return st == 0 ? 0 : code;
+}

@@ -1,0 +1,65 @@
+/* fdbtpu C client ABI — the fdb_c.h analog (reference bindings/c/
+ * foundationdb/fdb_c.h; implementation notes in fdbtpu_c.cpp).
+ *
+ * Blocking, thread-compatible-per-database handle.  Error codes match the
+ * gateway protocol (foundationdb_tpu/tools/gateway.py):
+ *   0 ok, 1 not_committed, 2 transaction_too_old, 3 commit_unknown_result,
+ *   4 future_version, 5 timed_out, 6 bad_request, 255 internal,
+ *   -1 connection failure.
+ * Codes 1..5 are retryable: pass them to fdbtpu_txn_on_error and re-run
+ * the transaction body (the fdb on_error loop).
+ */
+#ifndef FDBTPU_C_H
+#define FDBTPU_C_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct FDBTPU_Database FDBTPU_Database;
+
+FDBTPU_Database *fdbtpu_open(const char *host, int port);
+void fdbtpu_close(FDBTPU_Database *db);
+
+/* returns 0 on success; txn id out-param */
+int fdbtpu_txn_create(FDBTPU_Database *db, uint64_t *txn);
+int fdbtpu_txn_destroy(FDBTPU_Database *db, uint64_t txn);
+int fdbtpu_txn_reset(FDBTPU_Database *db, uint64_t txn);
+
+int fdbtpu_txn_set(FDBTPU_Database *db, uint64_t txn,
+                   const uint8_t *key, uint32_t key_len,
+                   const uint8_t *val, uint32_t val_len);
+int fdbtpu_txn_clear_range(FDBTPU_Database *db, uint64_t txn,
+                           const uint8_t *begin, uint32_t begin_len,
+                           const uint8_t *end, uint32_t end_len);
+int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
+                          const uint8_t *key, uint32_t key_len, int64_t delta);
+
+/* *present=0/1; on present, *val is malloc'd (caller frees), *val_len set */
+int fdbtpu_txn_get(FDBTPU_Database *db, uint64_t txn,
+                   const uint8_t *key, uint32_t key_len,
+                   int *present, uint8_t **val, uint32_t *val_len);
+
+/* rows returned as one malloc'd blob: n × (u32 klen, key, u32 vlen, val);
+ * caller frees *blob */
+int fdbtpu_txn_get_range(FDBTPU_Database *db, uint64_t txn,
+                         const uint8_t *begin, uint32_t begin_len,
+                         const uint8_t *end, uint32_t end_len,
+                         uint32_t limit, uint32_t *n_rows,
+                         uint8_t **blob, uint32_t *blob_len);
+
+int fdbtpu_txn_commit(FDBTPU_Database *db, uint64_t txn, int64_t *version);
+int fdbtpu_txn_get_read_version(FDBTPU_Database *db, uint64_t txn,
+                                int64_t *version);
+
+/* backoff + reset for a retryable code; returns 0 if the caller should
+ * retry the body, else the (non-retryable) code */
+int fdbtpu_txn_on_error(FDBTPU_Database *db, uint64_t txn, int code);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* FDBTPU_C_H */

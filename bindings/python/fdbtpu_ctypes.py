@@ -1,0 +1,175 @@
+"""Python binding over the C client ABI — proof that libfdbtpu_c.so serves
+any FFI-capable language (the script-bindings slot: reference
+bindings/python/fdb/impl.py wraps fdb_c the same way).
+
+Usage:
+    db = FdbTpu("libfdbtpu_c.so", host, port)
+    with db.transaction() as tr:
+        tr[b"k"] = b"v"
+    # commit on clean exit, on_error+retry on retryable failures
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+
+class FdbTpuError(Exception):
+    def __init__(self, code: int) -> None:
+        super().__init__(f"fdbtpu error {code}")
+        self.code = code
+
+
+class _Txn:
+    def __init__(self, db: "FdbTpu", tid: int) -> None:
+        self._db = db
+        self._tid = tid
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._db._check(
+            self._db._lib.fdbtpu_txn_set(
+                self._db._h, self._tid, key, len(key), value, len(value)
+            )
+        )
+
+    __setitem__ = set
+
+    def get(self, key: bytes) -> bytes | None:
+        present = ctypes.c_int()
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_uint32()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_get(
+                self._db._h, self._tid, key, len(key),
+                ctypes.byref(present), ctypes.byref(val), ctypes.byref(vlen),
+            )
+        )
+        if not present.value:
+            return None
+        out = bytes(bytearray(val[i] for i in range(vlen.value)))
+        self._db._libc.free(val)
+        return out
+
+    def __getitem__(self, key: bytes) -> bytes | None:
+        return self.get(key)
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._db._check(
+            self._db._lib.fdbtpu_txn_clear_range(
+                self._db._h, self._tid, begin, len(begin), end, len(end)
+            )
+        )
+
+    def atomic_add(self, key: bytes, delta: int) -> None:
+        self._db._check(
+            self._db._lib.fdbtpu_txn_atomic_add(
+                self._db._h, self._tid, key, len(key), delta
+            )
+        )
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
+        n = ctypes.c_uint32()
+        blob = ctypes.POINTER(ctypes.c_uint8)()
+        blob_len = ctypes.c_uint32()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_get_range(
+                self._db._h, self._tid, begin, len(begin), end, len(end),
+                limit, ctypes.byref(n), ctypes.byref(blob), ctypes.byref(blob_len),
+            )
+        )
+        raw = bytes(bytearray(blob[i] for i in range(blob_len.value)))
+        if blob_len.value:
+            self._db._libc.free(blob)
+        rows, off = [], 0
+        for _ in range(n.value):
+            klen = int.from_bytes(raw[off : off + 4], "little")
+            off += 4
+            k = raw[off : off + klen]
+            off += klen
+            vlen = int.from_bytes(raw[off : off + 4], "little")
+            off += 4
+            v = raw[off : off + vlen]
+            off += vlen
+            rows.append((k, v))
+        return rows
+
+    def commit(self) -> int:
+        version = ctypes.c_int64()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_commit(
+                self._db._h, self._tid, ctypes.byref(version)
+            )
+        )
+        return version.value
+
+    def on_error(self, code: int) -> None:
+        rc = self._db._lib.fdbtpu_txn_on_error(self._db._h, self._tid, code)
+        if rc != 0:
+            raise FdbTpuError(rc)
+
+    def destroy(self) -> None:
+        self._db._lib.fdbtpu_txn_destroy(self._db._h, self._tid)
+
+
+class FdbTpu:
+    def __init__(self, libpath: str, host: str, port: int) -> None:
+        self._lib = lib = ctypes.CDLL(libpath)
+        self._libc = ctypes.CDLL(None)
+        C = ctypes
+        u8p, u32, u64, i64 = (
+            C.POINTER(C.c_uint8), C.c_uint32, C.c_uint64, C.c_int64
+        )
+        lib.fdbtpu_open.restype = C.c_void_p
+        lib.fdbtpu_open.argtypes = [C.c_char_p, C.c_int]
+        lib.fdbtpu_close.argtypes = [C.c_void_p]
+        lib.fdbtpu_txn_create.argtypes = [C.c_void_p, C.POINTER(u64)]
+        for name in ("fdbtpu_txn_destroy", "fdbtpu_txn_reset"):
+            getattr(lib, name).argtypes = [C.c_void_p, u64]
+        lib.fdbtpu_txn_set.argtypes = [C.c_void_p, u64, C.c_char_p, u32,
+                                       C.c_char_p, u32]
+        lib.fdbtpu_txn_clear_range.argtypes = [C.c_void_p, u64, C.c_char_p,
+                                               u32, C.c_char_p, u32]
+        lib.fdbtpu_txn_atomic_add.argtypes = [C.c_void_p, u64, C.c_char_p,
+                                              u32, i64]
+        lib.fdbtpu_txn_get.argtypes = [C.c_void_p, u64, C.c_char_p, u32,
+                                       C.POINTER(C.c_int), C.POINTER(u8p),
+                                       C.POINTER(u32)]
+        lib.fdbtpu_txn_get_range.argtypes = [
+            C.c_void_p, u64, C.c_char_p, u32, C.c_char_p, u32, u32,
+            C.POINTER(u32), C.POINTER(u8p), C.POINTER(u32),
+        ]
+        lib.fdbtpu_txn_commit.argtypes = [C.c_void_p, u64, C.POINTER(i64)]
+        lib.fdbtpu_txn_get_read_version.argtypes = [C.c_void_p, u64,
+                                                    C.POINTER(i64)]
+        lib.fdbtpu_txn_on_error.argtypes = [C.c_void_p, u64, C.c_int]
+        self._libc.free.argtypes = [C.c_void_p]
+        self._h = C.c_void_p(lib.fdbtpu_open(host.encode(), port))
+        if not self._h:
+            raise FdbTpuError(-1)
+
+    @staticmethod
+    def _check(code: int) -> None:
+        if code != 0:
+            raise FdbTpuError(code)
+
+    def create_transaction(self) -> _Txn:
+        tid = ctypes.c_uint64()
+        self._check(self._lib.fdbtpu_txn_create(self._h, ctypes.byref(tid)))
+        return _Txn(self, tid.value)
+
+    def run(self, fn):
+        """The fdb.transactional retry loop over the C ABI."""
+        tr = self.create_transaction()
+        try:
+            while True:
+                try:
+                    out = fn(tr)
+                    tr.commit()
+                    return out
+                except FdbTpuError as e:
+                    tr.on_error(e.code)  # raises when not retryable
+        finally:
+            tr.destroy()
+
+    def close(self) -> None:
+        self._lib.fdbtpu_close(self._h)

@@ -151,6 +151,13 @@ class ClusterController:
         )
 
     # -- process pool -------------------------------------------------------
+    @staticmethod
+    def spread_slot(i: int, n: int, ring_len: int) -> int:
+        """Even-spread ring slot for the i-th of n same-kind roles — the one
+        placement formula shared by pipeline recruitment and the cluster's
+        coordinator placement."""
+        return (i * ring_len) // max(n, 1) % ring_len
+
     def _new_proc(self, role: str, spread: tuple[int, int] | None = None) -> SimProcess:
         """spread=(i, n): place the i-th of n same-kind roles evenly across
         the machine ring — TLog/proxy replicas must straddle DCs, or one
@@ -161,10 +168,10 @@ class ClusterController:
         if self.machines:
             if spread is not None:
                 i, n = spread
-                idx = (i * len(self.machines)) // max(n, 1)
+                idx = self.spread_slot(i, n, len(self.machines))
             else:
-                idx = self._proc_seq
-            m, d = self.machines[idx % len(self.machines)]
+                idx = self._proc_seq % len(self.machines)
+            m, d = self.machines[idx]
             extra = {"machine": m, "dc": d}
         return self.net.create_process(
             f"{role}-e{self.epoch}-{self._proc_seq}", **extra

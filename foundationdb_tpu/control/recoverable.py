@@ -93,28 +93,42 @@ class RecoverableCluster:
 
         def mach_spread(i: int, n: int) -> dict:
             """i-th of n same-kind roles, spread evenly over the ring (the
-            coordinator quorum must straddle DCs like TLogs do)."""
+            coordinator quorum must straddle DCs like TLogs do) — the same
+            policy ClusterController._new_proc(spread=...) applies to the
+            pipeline roles it recruits."""
             if not self.machines:
                 return {}
-            m, d = self.machines[(i * len(self.machines)) // max(n, 1) % len(self.machines)]
+            m, d = self.machines[ClusterController.spread_slot(i, n, len(self.machines))]
             return {"machine": m, "dc": d}
 
         by_dc: dict[str, list[str]] = {}
         for m, d in self.machines:
             by_dc.setdefault(d, []).append(m)
         dc_names = sorted(by_dc)
+        if self.machines and storage_replication > n_machines:
+            raise ValueError(
+                f"cannot place {storage_replication} replicas on "
+                f"{n_machines} machines distinctly"
+            )
+        dc_of = dict(self.machines)
 
-        def mach_replica(shard: int, r: int) -> dict:
+        def mach_replica(shard: int, r: int, used: set) -> dict:
             """Replica r of a shard goes to DC (r mod n_dcs), cycling
-            machines within it — replicas are in DIFFERENT DCs whenever
-            replication <= n_dcs, and on different machines regardless
-            (exact for any ring size, unlike a fixed machine offset)."""
+            machines within it; if the DC ring is exhausted (replication >
+            machines-per-DC), fall back to the first machine not yet used
+            by this shard — distinct machines for any config, distinct DCs
+            whenever replication <= n_dcs."""
             if not self.machines:
                 return {}
             d = dc_names[r % len(dc_names)]
             ring = by_dc[d]
             m = ring[(shard + r // len(dc_names)) % len(ring)]
-            return {"machine": m, "dc": d}
+            if m in used:
+                m = next(
+                    mm for mm, _dd in self.machines if mm not in used
+                )
+            used.add(m)
+            return {"machine": m, "dc": dc_of[m]}
 
         self._initial_storage_splits = splits(n_storage_shards)
         resolver_splits = splits(n_resolvers)
@@ -151,9 +165,10 @@ class RecoverableCluster:
 
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
+            used_machines: set = set()
             for r in range(storage_replication):
                 p = self.net.create_process(
-                    f"storage-{i}r{r}", **mach_replica(i, r)
+                    f"storage-{i}r{r}", **mach_replica(i, r, used_machines)
                 )
                 store = make_store(f"ss{i}r{r}.kv", p)
                 start_version = (

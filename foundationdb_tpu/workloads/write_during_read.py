@@ -55,11 +55,17 @@ class WriteDuringReadWorkload(Workload):
                 await tr.commit()
                 self._model = local
                 self.committed += 1
-            except Exception as e:  # noqa: BLE001 — retryable → retry loop
+            except Exception as e:  # noqa: BLE001 — retryable → resync
                 from ..client.transaction import RETRYABLE_ERRORS
 
                 if isinstance(e, RETRYABLE_ERRORS):
-                    continue  # model unchanged; this txn is abandoned
+                    # an unknown-result commit may have APPLIED: re-read the
+                    # authoritative state instead of assuming the model
+                    async def snap(tr):
+                        return await tr.get_range(b"wdr/", b"wdr0", limit=10000)
+
+                    self._model = dict(await db.run(snap))
+                    continue
                 raise
 
     async def check(self, cluster, rng) -> bool:

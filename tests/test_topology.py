@@ -200,3 +200,14 @@ def test_majority_dc_loss_with_spread_coordinators():
 
     assert c.run_until(c.loop.spawn(main()), 900) == 10
     c.stop()
+
+
+def test_replica_placement_distinct_machines_small_ring():
+    """replication > machines-per-DC must still give distinct machines
+    (DC separation is impossible with 2 machines/3 replicas by pigeonhole,
+    machine separation is not)."""
+    c = RecoverableCluster(seed=711, n_storage_shards=2, storage_replication=3,
+                           n_machines=3, n_dcs=2)
+    for team in c.storage_teams():
+        assert len({ss.process.machine for ss in team}) == 3
+    c.stop()
