@@ -73,6 +73,15 @@ class GenerationRoles:
     tlogs: list[TLog]
     processes: list[SimProcess]
     ping_tasks: list = dataclasses.field(default_factory=list)
+    # worker mode: the registry entries hosting this generation's roles
+    # (roles are destroyed via DestroyGenerationRequest, not process kills —
+    # workers outlive generations, exactly like the reference's fdbserver
+    # processes)
+    workers: list = dataclasses.field(default_factory=list)
+    # actual TLog file paths (worker mode names them per recruit attempt so
+    # a timed-out-then-retried recruit can never double-open one file);
+    # recorded in the cstate so restart recovery reads the right files
+    tlog_paths: list = dataclasses.field(default_factory=list)
 
     @property
     def proxy(self) -> CommitProxy:
@@ -103,6 +112,10 @@ class ClusterController:
         restart: bool = False,  # bootstrap generation 1 from on-disk TLogs
         machines: list[tuple[str, str]] | None = None,  # (name, dc) ring for
                                 # role placement (sim2 machine model)
+        expect_workers: bool = False,  # recruit roles onto REGISTERED
+                                # workers via RPC (worker.actor.cpp
+                                # bootstrap); False = construct directly
+                                # (unit tests / static clusters)
     ) -> None:
         self.loop = loop
         self.net = net
@@ -126,6 +139,22 @@ class ClusterController:
         self.fs = fs
         self.restart = restart
         self.machines = machines or []
+        self.expect_workers = expect_workers
+        # worker registry (ClusterController.actor.cpp registerWorker):
+        # name -> {recruit_ep, pclass, machine, last_seen}; entries expire
+        self._worker_registry: dict[str, dict] = {}
+        self._register_task = None
+        if expect_workers:
+            from ..roles.worker import CONFLICT_FACTORIES, WLT_REGISTER
+
+            self._register_stream = RequestStream(self._cc_proc(), WLT_REGISTER)
+            self._register_task = loop.spawn(
+                self._serve_register(), TaskPriority.COORDINATION, "cc-register"
+            )
+            # recruit RPCs carry only plain data: the conflict-backend
+            # factory is registered under a token (roles/worker.py)
+            self._cs_token = f"cs-{id(self)}"
+            CONFLICT_FACTORIES[self._cs_token] = conflict_backend
         if restart and fs is not None and fs.exists(self.KEYSERVERS_PATH):
             # data distribution moved shards in a previous life: the on-disk
             # keyServers map, not the tag naming convention, says where the
@@ -217,15 +246,29 @@ class ClusterController:
                 recovery_version, tag_data = self._recover_tlogs_from_disk(
                     prev_state["epoch"],
                     prev_state.get("n_tlogs", self.n_tlogs),
+                    prev_state.get("tlog_paths"),
                 )
             else:
                 recovery_version, tag_data = await self._lock_old_tlogs(old)
 
-            # RECRUITING: fresh pipeline on fresh processes
+            # RECRUITING: fresh pipeline on fresh processes (or, in worker
+            # mode, recruited onto surviving workers)
             self._set_state(RecoveryState.RECRUITING)
             if old is not None:
-                for p in old.processes:
-                    p.kill()  # old roles may not serve a split-brain
+                if old.workers:
+                    # workers outlive generations: destroy the hosted roles
+                    # remotely, never the worker processes.  An unreachable
+                    # worker's roles are fenced by protocol anyway (locked
+                    # TLogs refuse commits, confirmEpochLive parks GRVs).
+                    for w in old.workers:
+                        from ..roles.worker import DestroyGenerationRequest
+
+                        RequestStreamRef(
+                            self.net, self._cc_proc(), w["recruit_ep"]
+                        ).send(DestroyGenerationRequest(old.epoch))
+                else:
+                    for p in old.processes:
+                        p.kill()  # old roles may not serve a split-brain
                 for t in old.ping_tasks:
                     t.cancel()
                 # cancel the deposed roles' tasks too: a killed process stops
@@ -235,7 +278,7 @@ class ClusterController:
                     [old.sequencer] + old.proxies + old.resolvers + old.tlogs
                 ):
                     role.stop()
-            gen = self._recruit(recovery_version, tag_data)
+            gen = await self._recruit(recovery_version, tag_data)
             # durable-seed barrier: the new TLogs' RESET records (carrying
             # every surviving committed byte) must be on disk before the
             # cstate names this epoch — else a power loss between the write
@@ -249,18 +292,17 @@ class ClusterController:
             if self.cstate is not None:
                 ok = await self.cstate.write(
                     {"epoch": self.epoch, "recovery_version": recovery_version,
-                     "n_tlogs": self.n_tlogs}
+                     "n_tlogs": self.n_tlogs, "tlog_paths": gen.tlog_paths}
                 )
                 if not ok:
-                    for p in gen.processes:
-                        p.kill()
+                    self._teardown_generation(gen)
                     raise RuntimeError("lost cstate race: a newer master exists")
             if self.fs is not None:
                 # previous epochs' TLog files are superseded by this epoch's
                 # durable RESETs + the cstate record naming this epoch;
                 # enumerate ALL tlog files (old epochs may have had more
                 # slots than the current config)
-                current = {self._tlog_path(i, self.epoch) for i in range(self.n_tlogs)}
+                current = set(gen.tlog_paths)
                 for path in self.fs.list("tlog"):
                     if path not in current:
                         self.fs.delete(path)
@@ -290,7 +332,11 @@ class ClusterController:
             # but partitioned TLog must not be bypassed (it could still be
             # acking; the lock fence is what stops it).
             if self.fs is not None and not t.process.alive:
-                reply = self._read_tlog_file(self._tlog_path(i, old.epoch))
+                path = (
+                    old.tlog_paths[i] if i < len(old.tlog_paths)
+                    else self._tlog_path(i, old.epoch)
+                )
+                reply = self._read_tlog_file(path)
                 if reply is not None:
                     replies.append(reply)
                     continue
@@ -344,7 +390,8 @@ class ClusterController:
         end, _kc, tags = TLog.recover_state(dq)
         return TLogLockReply(end_version=end, tags=tags)
 
-    def _recover_tlogs_from_disk(self, prev_epoch: int, prev_n_tlogs: int):
+    def _recover_tlogs_from_disk(self, prev_epoch: int, prev_n_tlogs: int,
+                                 prev_paths: list[str] | None = None):
         """Whole-cluster restart: rebuild (recovery_version, seeds) from the
         previous epoch's synced TLog files.  Unsynced suffixes died with the
         power loss; every acked commit was synced on EVERY replica, so the
@@ -354,10 +401,10 @@ class ClusterController:
         write), not the new config's — restarting with fewer TLog slots must
         still replay every old slot's file or tags whose replica pair lived
         in the dropped slots would be silently lost."""
-        replies = [
-            self._read_tlog_file(self._tlog_path(i, prev_epoch))
-            for i in range(prev_n_tlogs)
+        paths = prev_paths or [
+            self._tlog_path(i, prev_epoch) for i in range(prev_n_tlogs)
         ]
+        replies = [self._read_tlog_file(p) for p in paths]
         alive = [r for r in replies if r is not None]
         if not alive:
             raise RuntimeError("no TLog files recovered: data loss")
@@ -600,7 +647,194 @@ class ClusterController:
             self._cc_process = self.net.create_process("cluster-controller")
         return self._cc_process
 
-    def _recruit(self, recovery_version: Version, tlog_seeds: list[dict]) -> GenerationRoles:
+    # -- worker registry + recruitment (worker.actor.cpp bootstrap) ----------
+    async def _serve_register(self) -> None:
+        while True:
+            req = await self._register_stream.next()
+            r = req.payload
+            self._worker_registry[r.name] = {
+                "recruit_ep": r.recruit_endpoint,
+                "pclass": r.process_class,
+                "machine": r.machine,
+                "name": r.name,
+                "last_seen": self.loop.now(),
+            }
+
+    def _live_workers(self) -> list[dict]:
+        now = self.loop.now()
+        return [
+            w for w in self._worker_registry.values()
+            if now - w["last_seen"] < 2.0
+        ]
+
+    async def _recruit_on_worker(self, kind: str, params: dict, loads: dict,
+                                 avoid_machines: set | None = None):
+        """Pick the fittest live worker (preferred class, least loaded,
+        off the machines already hosting this kind) and recruit the role
+        there; dead workers are pruned and the next one tried.  Returns
+        (role, worker_info)."""
+        from ..roles.worker import PREFERRED_CLASS, RecruitRoleRequest
+
+        pref = PREFERRED_CLASS.get(kind, "stateless")
+        avoid = avoid_machines or set()
+        deadline = self.loop.now() + 5.0
+        while True:
+            cands = self._live_workers()
+            cands.sort(
+                key=lambda w: (
+                    w["machine"] is not None and w["machine"] in avoid,
+                    w["pclass"] != pref,
+                    loads.get(w["name"], 0),
+                    w["name"],
+                )
+            )
+            for w in cands:
+                ref = RequestStreamRef(self.net, self._cc_proc(), w["recruit_ep"])
+                try:
+                    reply = await ref.get_reply(
+                        RecruitRoleRequest(kind, self.epoch, params), timeout=1.0
+                    )
+                except (TimedOut, BrokenPromise):
+                    self._worker_registry.pop(w["name"], None)
+                    continue
+                loads[w["name"]] = loads.get(w["name"], 0) + 1
+                from ..roles.worker import SIM_ROLE_HANDLES
+
+                return SIM_ROLE_HANDLES.pop(reply.handle), w
+            if self.loop.now() >= deadline:
+                raise RuntimeError(
+                    f"no live worker available to host {kind!r}"
+                )
+            await self.loop.delay(0.1, TaskPriority.COORDINATION)
+
+    async def _recruit(self, recovery_version: Version, tlog_seeds: list[dict]) -> GenerationRoles:
+        if self.expect_workers:
+            return await self._recruit_via_workers(recovery_version, tlog_seeds)
+        return self._recruit_direct(recovery_version, tlog_seeds)
+
+    async def _recruit_via_workers(
+        self, recovery_version: Version, tlog_seeds: list[dict]
+    ) -> GenerationRoles:
+        """RPC recruitment onto registered workers (the reference's CC
+        sending InitializeXxxRequest to worker interfaces; fitness-ordered
+        worker choice in _recruit_on_worker)."""
+        from ..roles.worker import PruneGenerationRequest
+
+        start_v = recovery_version + 1_000_000
+        loads: dict[str, int] = {}
+        used: list = []
+        nonces: list[str] = []
+        kind_machines: dict[str, set] = {}
+
+        # sweep leftovers of any ABORTED recovery epoch before recruiting
+        # (a mid-recruit failure leaves live roles on workers; their epoch
+        # is neither the live generation's nor this one's)
+        keep_epoch = self.generation.epoch if self.generation else -1
+        for w in self._live_workers():
+            RequestStreamRef(self.net, self._cc_proc(), w["recruit_ep"]).send(
+                PruneGenerationRequest(
+                    epoch=-1, keep_nonces=[], below_epoch=self.epoch,
+                    keep_epoch=keep_epoch,
+                )
+            )
+
+        async def recruit(kind: str, params: dict):
+            nonce = self.rng.random_unique_id()[:8]
+            params = {**params, "nonce": nonce}
+            role, w = await self._recruit_on_worker(
+                kind, params, loads, kind_machines.setdefault(kind, set())
+            )
+            nonces.append(nonce)
+            if w["machine"] is not None:
+                kind_machines[kind].add(w["machine"])
+            if all(u["name"] != w["name"] for u in used):
+                used.append(w)
+            return role
+
+        sequencer = await recruit("sequencer", {"start_version": start_v})
+        tlogs: list[TLog] = []
+        tlog_paths: list[str] = []
+        for i in range(self.n_tlogs):
+            # per-attempt file name: a recruit whose reply timed out may
+            # have built a TLog that opened its path — the retry must not
+            # share a file with that orphan
+            path = None
+            if self.fs is not None:
+                path = f"tlog{i}-e{self.epoch}-{self.rng.random_unique_id()[:6]}.dq"
+            t = await recruit("tlog", {
+                "start_version": start_v,
+                "seeds": tlog_seeds[i],
+                "known_committed": recovery_version,
+                "path": path,
+            })
+            tlogs.append(t)
+            if path is not None:
+                tlog_paths.append(path)
+        resolvers: list[Resolver] = []
+        for _i in range(len(self.resolver_splits) + 1):
+            resolvers.append(await recruit("resolver", {
+                "conflict_backend": self._cs_token,
+                "oldest": recovery_version,
+                "start_version": start_v,
+            }))
+        teams = self._storage_teams()
+        tag_teams = [[ss.tag for ss in team] for team in teams]
+        all_tags = [t for team in tag_teams for t in team]
+        proxies: list[CommitProxy] = []
+        for _i in range(self.n_proxies):
+            proxies.append(await recruit("proxy", {
+                "sequencer": sequencer.stream.endpoint,
+                "resolvers": [r.stream.endpoint for r in resolvers],
+                "resolver_splits": self.resolver_splits,
+                "tlog_commits": [t.commit_stream.endpoint for t in tlogs],
+                "tlog_confirms": [t.confirm_stream.endpoint for t in tlogs],
+                "storage_splits": self.storage_splits,
+                "storage_teams": self.storage_teams_tags,
+                "tag_to_tlogs": {t: self._tag_tlogs(t) for t in all_tags},
+                "start_version": start_v,
+            }))
+        for p in proxies:
+            p.ratekeeper = self.ratekeeper
+            p.on_commit_failure = self._on_proxy_failure
+        if self.backup_worker is not None:
+            from ..roles.backup import BACKUP_TAG
+
+            for p in proxies:
+                p.tag_to_tlogs = {
+                    **p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)
+                }
+                p.backup_tag = BACKUP_TAG
+        for p in proxies:
+            p.peers = [
+                RequestStreamRef(
+                    self.net, p.commit_stream._process,
+                    q.raw_version_stream.endpoint,
+                )
+                for q in proxies
+                if q is not p
+            ]
+        # same-epoch orphans (a recruit retried after its reply timed out
+        # in flight) are stopped now that the full set is known
+        for w in self._live_workers():
+            RequestStreamRef(self.net, self._cc_proc(), w["recruit_ep"]).send(
+                PruneGenerationRequest(
+                    epoch=self.epoch, keep_nonces=list(nonces),
+                    below_epoch=self.epoch, keep_epoch=keep_epoch,
+                )
+            )
+        addrs = (
+            [sequencer.stream.endpoint.address]
+            + [t.commit_stream.endpoint.address for t in tlogs]
+            + [r.stream.endpoint.address for r in resolvers]
+            + [p.commit_stream.endpoint.address for p in proxies]
+        )
+        procs = [self.net.processes[a] for a in dict.fromkeys(addrs)]
+        return GenerationRoles(
+            self.epoch, sequencer, proxies, resolvers, tlogs, procs,
+            ping_tasks=[], workers=used, tlog_paths=tlog_paths,
+        )
+
+    def _recruit_direct(self, recovery_version: Version, tlog_seeds: list[dict]) -> GenerationRoles:
         procs: list[SimProcess] = []
         ping_tasks: list = []
 
@@ -625,6 +859,7 @@ class ClusterController:
         )
 
         tlogs: list[TLog] = []
+        tlog_paths: list[str] = []
         for i in range(self.n_tlogs):
             p = self._new_proc(f"tlog{i}", spread=(i, self.n_tlogs))
             procs.append(p)
@@ -633,7 +868,9 @@ class ClusterController:
             if self.fs is not None:
                 from ..storage.diskqueue import DiskQueue
 
-                dq = DiskQueue(self.fs.open(self._tlog_path(i, self.epoch), p))
+                path = self._tlog_path(i, self.epoch)
+                tlog_paths.append(path)
+                dq = DiskQueue(self.fs.open(path, p))
             tlogs.append(
                 TLog(p, self.loop, start_version=recovery_version + 1_000_000,
                      initial_tags=tlog_seeds[i],
@@ -708,7 +945,8 @@ class ClusterController:
                 if q is not p
             ]
         return GenerationRoles(
-            self.epoch, sequencer, proxies, resolvers, tlogs, procs, ping_tasks
+            self.epoch, sequencer, proxies, resolvers, tlogs, procs,
+            ping_tasks, tlog_paths=tlog_paths,
         )
 
     def _rewire(self, gen: GenerationRoles, recovery_version: Version | None = None) -> None:
@@ -850,6 +1088,24 @@ class ClusterController:
                 raise TimedOut("commit plane never drained for rebalance")
             await self.loop.delay(0.005, TaskPriority.COORDINATION)
 
+    def _teardown_generation(self, gen: GenerationRoles) -> None:
+        """Dispose a generation that must not serve (lost cstate race,
+        controller stop): worker-hosted roles are destroyed remotely —
+        workers outlive generations — while directly-constructed ones lose
+        their processes."""
+        if gen.workers:
+            from ..roles.worker import DestroyGenerationRequest
+
+            for w in gen.workers:
+                RequestStreamRef(
+                    self.net, self._cc_proc(), w["recruit_ep"]
+                ).send(DestroyGenerationRequest(gen.epoch))
+            for role in [gen.sequencer] + gen.proxies + gen.resolvers + gen.tlogs:
+                role.stop()
+        else:
+            for p in gen.processes:
+                p.kill()
+
     def _on_proxy_failure(self, proxy, exc) -> None:
         """A proxy exhausted its commit-path retry budget (e.g. a partition
         between proxy and resolver that heartbeats can't see): its assigned
@@ -964,6 +1220,8 @@ class ClusterController:
                     )
 
     def stop(self) -> None:
+        if getattr(self, "_register_task", None) is not None:
+            self._register_task.cancel()
         if getattr(self, "_balance_task", None) is not None:
             self._balance_task.cancel()
         if getattr(self, "_conf_task", None) is not None:
@@ -971,5 +1229,4 @@ class ClusterController:
         if self._monitor_task is not None:
             self._monitor_task.cancel()
         if self.generation is not None:
-            for p in self.generation.processes:
-                p.kill()
+            self._teardown_generation(self.generation)

@@ -54,6 +54,11 @@ class RecoverableCluster:
                                 # replicas placed across machines AND DCs,
                                 # correlated kills via net.kill_machine/_dc
         n_dcs: int = 2,         # DC labels when n_machines > 0
+        n_workers: int = 0,     # >0: pipeline roles are RECRUITED onto a
+                                # registered worker pool via RPC (the
+                                # worker.actor.cpp bootstrap) and a
+                                # fdbmonitor analog restarts dead workers;
+                                # 0 = roles constructed directly
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -205,7 +210,29 @@ class RecoverableCluster:
             fs=self.fs,
             restart=restart,
             machines=self.machines,
+            expect_workers=n_workers > 0,
         )
+
+        # worker pool + fdbmonitor analog (fdbmonitor/fdbmonitor.cpp: the
+        # supervisor that restarts dead fdbserver processes; here a dead
+        # worker gets a fresh process that re-registers with the CC)
+        from ..roles.worker import Worker
+
+        self.workers: list[Worker] = []
+        self._worker_classes: list[str] = []
+        if n_workers > 0:
+            reg_ep = self.controller._register_stream.endpoint
+            classes = (
+                ["transaction"] * n_tlogs
+                + ["stateless"] * (n_proxies + len(resolver_splits) + 2)
+            )
+            for i in range(n_workers):
+                pclass = classes[i] if i < len(classes) else "stateless"
+                self._worker_classes.append(pclass)
+                self.workers.append(self._spawn_worker(i, pclass, reg_ep))
+            self._monitor_task = self.loop.spawn(
+                self._fdbmonitor(reg_ep), 0, "fdbmonitor"
+            )
         self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
         from .ratekeeper import Ratekeeper
 
@@ -245,6 +272,35 @@ class RecoverableCluster:
             store_factory=_heal_store,
         )
 
+    def _spawn_worker(self, idx: int, pclass: str, reg_ep):
+        from ..roles.worker import Worker
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        extra = {}
+        if self.machines:
+            m, d = self.machines[idx % len(self.machines)]
+            extra = {"machine": m, "dc": d}
+        proc = self.net.create_process(
+            f"worker-{idx}-{self.rng.random_unique_id()[:4]}", **extra
+        )
+        return Worker(
+            proc, self.loop, self.knobs,
+            register_ref=_Ref(self.net, proc, reg_ep),
+            process_class=pclass, fs=self.fs,
+        )
+
+    async def _fdbmonitor(self, reg_ep) -> None:
+        """Restart dead workers with fresh processes (fdbmonitor's restart
+        loop); the replacement re-registers and becomes recruitable."""
+        while True:
+            await self.loop.delay(1.0)
+            for i, w in enumerate(self.workers):
+                if not w.process.alive:
+                    w.stop()
+                    self.workers[i] = self._spawn_worker(
+                        i, self._worker_classes[i], reg_ep
+                    )
+
     @property
     def storage_splits(self) -> list[bytes]:
         """The LIVE shard boundaries (data distribution mutates them)."""
@@ -271,6 +327,10 @@ class RecoverableCluster:
             cluster2 = RecoverableCluster(seed=..., fs=fs, restart=True)
         """
         assert self.fs is not None, "power_off needs a durable cluster"
+        if getattr(self, "_monitor_task", None) is not None:
+            self._monitor_task.cancel()
+        for w in self.workers:
+            w.stop()
         self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()
@@ -283,6 +343,10 @@ class RecoverableCluster:
         return self.fs
 
     def stop(self) -> None:
+        if getattr(self, "_monitor_task", None) is not None:
+            self._monitor_task.cancel()
+        for w in self.workers:
+            w.stop()
         self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()
