@@ -31,7 +31,13 @@ from ..roles.types import (
     Version,
 )
 from ..rpc.stream import RequestStreamRef
-from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TimedOut
+from ..runtime.core import (
+    ActorCancelled,
+    BrokenPromise,
+    DeterministicRandom,
+    EventLoop,
+    TimedOut,
+)
 from ..runtime.trace import g_trace_batch
 from ..keys import key_after
 
@@ -86,6 +92,73 @@ class ClusterView:
         self.epoch = epoch
 
 
+class QueueModel:
+    """Per-replica latency/penalty model for read load-balancing
+    (fdbrpc/QueueModel.h + LoadBalance.actor.h:159): smoothed reply latency
+    plus an in-flight count per endpoint; picks the better of two random
+    candidates (the reference's alternatives comparison), and a broken
+    endpoint carries a decaying penalty so retries steer away from it."""
+
+    def __init__(self, clock) -> None:
+        self._clock = clock
+        # endpoint key -> [smoothed_latency, inflight, penalty_until, last_t]
+        self._stats: dict = {}
+
+    def _key(self, ref) -> tuple:
+        ep = ref.endpoint
+        return (ep.address, ep.token)
+
+    def _entry(self, ref):
+        e = self._stats.get(self._key(ref))
+        if e is None:
+            if len(self._stats) > 4096:
+                # endpoints churn with every recovery: drop the stalest
+                stale = min(self._stats, key=lambda k: self._stats[k][3])
+                del self._stats[stale]
+            e = self._stats[self._key(ref)] = [0.001, 0, 0.0, self._clock()]
+        return e
+
+    def _score(self, ref) -> float:
+        lat, inflight, penalty_until, last_t = self._entry(ref)
+        now = self._clock()
+        p = 10.0 if now < penalty_until else 0.0
+        if now - last_t > 2.0:
+            # a losing replica's estimate goes stale (it is never picked,
+            # so never refreshed): forget its history so it gets re-probed
+            # — the role of the reference LoadBalance's second requests
+            lat = 0.001
+        return lat * (1 + inflight) + p
+
+    def pick(self, rng, members: list, opkey: str):
+        if len(members) == 1:
+            return members[0][opkey]
+        i = rng.random_int(0, len(members))
+        j = (i + 1 + rng.random_int(0, len(members) - 1)) % len(members)
+        ra, rb = members[i][opkey], members[j][opkey]
+        return ra if self._score(ra) <= self._score(rb) else rb
+
+    def on_start(self, ref) -> None:
+        self._entry(ref)[1] += 1
+
+    def on_reply(self, ref, latency: float) -> None:
+        e = self._entry(ref)
+        e[0] += (latency - e[0]) * 0.2
+        e[1] = max(e[1] - 1, 0)
+        e[3] = self._clock()
+
+    def on_abandon(self, ref) -> None:
+        """Timeout/cancel: no reply was observed — never feed the elapsed
+        wait into the latency estimate (it measures the caller, not the
+        replica)."""
+        self._entry(ref)[1] = max(self._entry(ref)[1] - 1, 0)
+
+    def on_broken(self, ref) -> None:
+        e = self._entry(ref)
+        e[1] = max(e[1] - 1, 0)
+        e[2] = self._clock() + 1.0  # steer away while it is likely dead
+        e[3] = self._clock()
+
+
 class Database:
     def __init__(
         self,
@@ -96,6 +169,7 @@ class Database:
         self.loop = loop
         self.view = view
         self._rng = rng.split()
+        self._qm = QueueModel(loop.now)
         # fraction of transactions given a pipeline-timeline debug ID
         # (g_traceBatch; the reference samples via CLIENT_KNOBS->
         # *_DEBUG_TRANSACTION_RATE)
@@ -244,15 +318,28 @@ class Transaction:
         effect), the reference's loadBalance/alternatives loop.  Only the
         overall deadline surfaces, as TimedOut."""
         loop = self.db.loop
+        qm = self.db._qm
         deadline = loop.now() + timeout
         while True:
             remaining = deadline - loop.now()
             if remaining <= 0:
                 raise TimedOut(f"timed out after {timeout}s")
+            ref = pick_ref()
+            qm.on_start(ref)
+            t0 = loop.now()
             try:
-                return await pick_ref().get_reply(payload, timeout=remaining)
+                reply = await ref.get_reply(payload, timeout=remaining)
+                qm.on_reply(ref, loop.now() - t0)
+                return reply
             except BrokenPromise:
+                qm.on_broken(ref)
                 await loop.delay(min(0.05, max(deadline - loop.now(), 0.001)))
+            except (TimedOut, ActorCancelled):
+                qm.on_abandon(ref)  # no reply observed: not a latency sample
+                raise
+            except Exception:
+                qm.on_reply(ref, loop.now() - t0)  # an error IS a reply
+                raise
 
     # -- read version -------------------------------------------------------
     async def get_read_version(self) -> Version:
@@ -277,9 +364,9 @@ class Transaction:
         # dead endpoint, so reads fail over to the surviving replicas
         g_trace_batch.add("NativeAPI.getValue.Before", self.debug_id)
         reply = await self._reply_rerouted(
-            lambda: self.db._rng.random_choice(
-                self.db._smap.member_for_key(key)
-            )["getvalue"],
+            lambda: self.db._qm.pick(
+                self.db._rng, self.db._smap.member_for_key(key), "getvalue"
+            ),
             GetValueRequest(key, v, debug_id=self.debug_id),
         )
         g_trace_batch.add("NativeAPI.getValue.After", self.debug_id)
@@ -300,9 +387,9 @@ class Transaction:
                 continue
             b, e = clip
             reply = await self._reply_rerouted(
-                lambda idx=idx: self.db._rng.random_choice(
-                    self.db._smap.members[idx]
-                )["getkeyvalues"],
+                lambda idx=idx: self.db._qm.pick(
+                    self.db._rng, self.db._smap.members[idx], "getkeyvalues"
+                ),
                 GetKeyValuesRequest(b, e, v, limit - len(out)),
             )
             out.extend(reply.data)

@@ -1,17 +1,24 @@
 """Ratekeeper — global admission control (fdbserver/Ratekeeper.actor.cpp).
 
-Watches storage-server write lag and TLog queue depth and computes a
-cluster-wide transactions-per-second budget (updateRate :250); the proxy's
-GRV service spends that budget, shedding load *before* queues melt down —
-the reference's core flow-control loop.
-"""
+Watches every storage server's durability lag and every TLog's queue depth
+and computes a cluster-wide transactions-per-second budget (updateRate
+:250) that the proxies' GRV service spends, shedding load *before* queues
+melt down — the reference's core flow-control loop.
+
+Per-server model (the reference's shape, simplified to this runtime's
+observables): each server's lag is exponentially SMOOTHED (Smoother, so
+transient spikes don't whipsaw admission), a proportional controller maps
+smoothed lag to a per-server TPS limit with slack above the target, the
+binding (minimum) constraint wins, and the published budget is itself
+smoothed.  `status()` exposes the per-server model the reference prints
+in its RkUpdate trace events."""
 
 from __future__ import annotations
 
 from ..roles.storage import StorageServer
-from ..roles.tlog import TLog
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.knobs import CoreKnobs
+from ..runtime.metrics import Smoother
 
 
 class Ratekeeper:
@@ -29,33 +36,92 @@ class Ratekeeper:
         self.tlogs_fn = tlogs_fn
         self.max_tps = max_tps
         self.tps_budget = max_tps
-        self.smoothed_release = 0.0
         self.limit_reason = "unlimited"
+        self.limiting_server: str | None = None
+        self._lag_smoothers: dict[str, Smoother] = {}
+        self._queue_smoothers: dict[int, Smoother] = {}
+        self._budget = Smoother(
+            knobs.RATEKEEPER_SMOOTHING_E, clock=loop.now
+        )
+        self._budget.reset(max_tps)
         self._task = loop.spawn(self._run(), TaskPriority.RATEKEEPER, "ratekeeper")
 
+    def _smoothed(self, table: dict, key, value: float) -> float:
+        s = table.get(key)
+        if s is None:
+            s = table[key] = Smoother(
+                self.knobs.RATEKEEPER_SMOOTHING_E, clock=self.loop.now
+            )
+            s.reset(value)
+        else:
+            s.set_total(value)
+        return s.smooth_total()
+
+    @staticmethod
+    def _limit(lag: float, target: float, max_tps: float) -> float:
+        """Proportional controller: full rate below target, linear squeeze
+        to the 1% floor as lag approaches 2x target (the spring the
+        reference's updateRate builds per server)."""
+        if lag <= target:
+            return max_tps
+        frac = max(0.0, (2 * target - lag) / target)
+        return max(max_tps * frac, max_tps * 0.01)
+
     def _update(self) -> None:
-        """One updateRate pass: the binding constraint wins."""
         tps = self.max_tps
         reason = "unlimited"
-        target_bytes = self.knobs.TARGET_QUEUE_BYTES
-        for t in self.tlogs_fn():
-            q = t.bytes_queued
-            if q > target_bytes:
-                frac = max(0.0, 1.0 - (q - target_bytes) / target_bytes)
-                if tps > self.max_tps * frac:
-                    tps = self.max_tps * frac
-                    reason = "tlog_queue"
-        window = self.knobs.mvcc_window_versions
+        limiting = None
+
+        # TLog smoothers are keyed by the TLog's own endpoint token: a
+        # recovery's fresh TLogs must start with fresh models, not inherit a
+        # deposed slot-mate's backlog estimate; departed keys are pruned
+        target_bytes = float(self.knobs.TARGET_QUEUE_BYTES)
+        tlogs = self.tlogs_fn()
+        live_keys = set()
+        for i, t in enumerate(tlogs):
+            key = t.commit_stream.endpoint.token
+            live_keys.add(key)
+            q = self._smoothed(self._queue_smoothers, key, float(t.bytes_queued))
+            lim = self._limit(q, target_bytes, self.max_tps)
+            if lim < tps:
+                tps, reason, limiting = lim, "tlog_queue", f"tlog{i}"
+        for key in [k for k in self._queue_smoothers if k not in live_keys]:
+            del self._queue_smoothers[key]
+
+        # storage smoothers key by TAG: a healed replacement inherits its
+        # predecessor's model on purpose (same data responsibility)
+        target_lag = 2.0 * self.knobs.mvcc_window_versions
+        live_tags = set()
         for ss in self.storage:
-            lag = ss.version.get() - ss.durable_version
-            # durability lag beyond ~2 MVCC windows: storage is drowning
-            if lag > 2 * window:
-                frac = max(0.0, 1.0 - (lag - 2 * window) / window)
-                if tps > self.max_tps * frac:
-                    tps = self.max_tps * frac
-                    reason = "storage_lag"
-        self.tps_budget = max(tps, self.max_tps * 0.01)
+            live_tags.add(ss.tag)
+            lag = self._smoothed(
+                self._lag_smoothers, ss.tag,
+                float(ss.version.get() - ss.durable_version),
+            )
+            lim = self._limit(lag, target_lag, self.max_tps)
+            if lim < tps:
+                tps, reason, limiting = lim, "storage_lag", ss.tag
+        for tag in [t for t in self._lag_smoothers if t not in live_tags]:
+            del self._lag_smoothers[tag]
+
+        self._budget.set_total(tps)
+        self.tps_budget = max(self._budget.smooth_total(), self.max_tps * 0.01)
         self.limit_reason = reason
+        self.limiting_server = limiting
+
+    def status(self) -> dict:
+        """The RkUpdate view: budget, binding constraint, per-server model."""
+        return {
+            "tps_budget": self.tps_budget,
+            "limit_reason": self.limit_reason,
+            "limiting_server": self.limiting_server,
+            "storage_lag_smoothed": {
+                tag: s.smooth_total() for tag, s in self._lag_smoothers.items()
+            },
+            "tlog_queue_smoothed": {
+                i: s.smooth_total() for i, s in self._queue_smoothers.items()
+            },
+        }
 
     async def _run(self) -> None:
         while True:

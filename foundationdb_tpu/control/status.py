@@ -82,6 +82,9 @@ def cluster_status(cluster) -> dict[str, Any]:
         }
     if controller is not None:
         doc["cluster"]["backup_running"] = controller.backup_worker is not None
+    rk = getattr(cluster, "ratekeeper", None)
+    if rk is not None:
+        doc["ratekeeper"] = rk.status()
     if loop.profile:
         doc["profiler"] = {
             "busy_s_by_priority": dict(loop.busy_s_by_priority),
@@ -125,6 +128,13 @@ STATUS_SCHEMA: dict = {
         {"tag": str, "version": int, "durable_version": int, "keys": int}
     ],
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
+    "ratekeeper?": {
+        "tps_budget": (int, float),
+        "limit_reason": str,
+        "limiting_server": (str, type(None)),
+        "storage_lag_smoothed": dict,
+        "tlog_queue_smoothed": dict,
+    },
 }
 
 

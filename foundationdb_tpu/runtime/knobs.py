@@ -76,6 +76,9 @@ class CoreKnobs(Knobs):
         # ratekeeper
         self.init("TARGET_QUEUE_BYTES", 1 << 27)
         self.init("RATEKEEPER_UPDATE_INTERVAL", 0.25)
+        # smoothing time constant for the ratekeeper's per-server model and
+        # published budget (reference SMOOTHING_AMOUNT, Knobs.cpp)
+        self.init("RATEKEEPER_SMOOTHING_E", 1.0)
 
         # data distribution (DataDistribution.actor.cpp): storage failure
         # ping cadence, shard-size poll cadence, and the split threshold

@@ -1,0 +1,103 @@
+"""Metrics core: exponential smoothing + percentile sampling
+(flow/Smoother.h Smoother/TimerSmoother; flow/ContinuousSample.h) — the
+time-series primitives the ratekeeper, load balancer, and perf workloads
+build on (flow/Stats.h counters live in runtime/trace.py)."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+
+class Smoother:
+    """Exponentially-smoothed total: `smooth_total` chases the true total
+    with time constant `e_time`, and `smooth_rate` is the smoothed
+    d(total)/dt — the reference's Smoother, used for rates and latencies
+    that must not whipsaw the control loops reading them."""
+
+    def __init__(self, e_time: float, clock: Callable[[], float]) -> None:
+        self.e_time = e_time
+        self._clock = clock
+        self._time = clock()
+        self._total = 0.0
+        self._estimate = 0.0
+
+    def reset(self, value: float) -> None:
+        self._total = value
+        self._estimate = value
+        self._time = self._clock()
+
+    def set_total(self, value: float) -> None:
+        self._advance()
+        self._total = value
+
+    def add_delta(self, delta: float) -> None:
+        self._advance()
+        self._total += delta
+
+    def _advance(self) -> None:
+        now = self._clock()
+        dt = now - self._time
+        if dt > 0:
+            self._estimate += (self._total - self._estimate) * (
+                1 - math.exp(-dt / self.e_time)
+            )
+            self._time = now
+
+    def smooth_total(self) -> float:
+        self._advance()
+        return self._estimate
+
+    def smooth_rate(self) -> float:
+        """Smoothed rate of change: (total - estimate) / e_time — exact for
+        a constant-rate input, lagging for steps (by design)."""
+        self._advance()
+        return (self._total - self._estimate) / self.e_time
+
+
+class ContinuousSample:
+    """Fixed-size uniform reservoir over a stream, with percentile reads
+    (flow/ContinuousSample.h): every element ever added has equal
+    probability of being in the sample, so percentiles track the whole
+    stream, not a recent window."""
+
+    def __init__(self, size: int, rng=None) -> None:
+        self._size = size
+        self._rng = rng
+        self._samples: list[float] = []
+        self._n = 0
+        self._sorted = True
+
+    def add(self, value: float) -> None:
+        self._n += 1
+        if len(self._samples) < self._size:
+            self._samples.append(value)
+            self._sorted = False
+        else:
+            if self._rng is not None:
+                j = self._rng.random_int(0, self._n)
+            else:
+                # private xorshift, NOT the global random module: sampling
+                # must never make a seeded simulation replay differently
+                self._x = (getattr(self, "_x", 0x9E3779B9) * 0x2545F491) & 0xFFFFFFFF
+                self._x ^= self._x >> 13
+                j = self._x % self._n
+            if j < self._size:
+                self._samples[j] = value
+                self._sorted = False
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return 0.0
+        if not self._sorted:
+            self._samples.sort()
+            self._sorted = True
+        idx = min(int(p * len(self._samples)), len(self._samples) - 1)
+        return self._samples[idx]
+
+    def median(self) -> float:
+        return self.percentile(0.5)

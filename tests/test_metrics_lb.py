@@ -1,0 +1,118 @@
+"""Metrics core (Smoother/ContinuousSample), the ratekeeper's smoothed
+per-server model, and the client's QueueModel load balancing
+(flow/Smoother.h; flow/ContinuousSample.h; Ratekeeper.actor.cpp updateRate;
+fdbrpc/QueueModel.h + LoadBalance.actor.h)."""
+
+from foundationdb_tpu.runtime.metrics import ContinuousSample, Smoother
+
+
+def test_smoother_tracks_constant_rate():
+    t = [0.0]
+    s = Smoother(1.0, clock=lambda: t[0])
+    for i in range(1, 101):
+        t[0] = i * 0.1
+        s.set_total(100.0 * t[0])  # 100 units/sec
+    # discrete 0.1s updates overshoot the continuous-time rate by ~dt/2/e
+    assert abs(s.smooth_rate() - 100.0) < 10.0
+    # smoothed total lags the true total by rate * e_time
+    assert s.smooth_total() < 100.0 * t[0]
+
+
+def test_smoother_step_converges():
+    t = [0.0]
+    s = Smoother(1.0, clock=lambda: t[0])
+    s.set_total(10.0)
+    t[0] = 0.5
+    mid = s.smooth_total()
+    assert 0 < mid < 10.0
+    t[0] = 10.0
+    assert abs(s.smooth_total() - 10.0) < 0.01
+
+
+def test_continuous_sample_percentiles():
+    cs = ContinuousSample(500)
+    for i in range(10000):
+        cs.add(float(i % 100))
+    assert cs.count == 10000
+    assert abs(cs.median() - 50.0) < 10.0
+    assert cs.percentile(0.95) >= 85.0
+    assert cs.percentile(0.05) <= 15.0
+
+
+class _FakeVersion:
+    def __init__(self, v):
+        self.v = v
+
+    def get(self):
+        return self.v
+
+
+class _FakeSS:
+    def __init__(self, tag, lag):
+        self.tag = tag
+        self.version = _FakeVersion(lag)
+        self.durable_version = 0
+
+
+def test_ratekeeper_squeezes_on_storage_lag_and_recovers():
+    from foundationdb_tpu.control.ratekeeper import Ratekeeper
+    from foundationdb_tpu.runtime.core import EventLoop
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+    loop = EventLoop()
+    knobs = CoreKnobs()
+    window = knobs.mvcc_window_versions
+    ss = _FakeSS("ss-0-r0", 0)
+    rk = Ratekeeper(loop, knobs, [ss], tlogs_fn=lambda: [], max_tps=1000.0)
+
+    async def run(seconds):
+        await loop.delay(seconds)
+
+    # healthy: full budget
+    loop.run_until(loop.spawn(run(3.0)), 1e9)
+    assert rk.tps_budget > 900.0
+    # drown the server: 4x window lag -> squeezed to the floor
+    ss.version.v = 4 * window
+    loop.run_until(loop.spawn(run(8.0)), 1e9)
+    assert rk.tps_budget < 200.0
+    assert rk.limit_reason == "storage_lag"
+    assert rk.limiting_server == "ss-0-r0"
+    # catch up: the SMOOTHED model recovers (not instantly)
+    ss.version.v = 0
+    loop.run_until(loop.spawn(run(0.3)), 1e9)
+    partway = rk.tps_budget
+    loop.run_until(loop.spawn(run(10.0)), 1e9)
+    assert rk.tps_budget > 900.0 > partway
+    rk.stop()
+
+
+def test_queue_model_prefers_fast_replica_and_penalizes_broken():
+    from foundationdb_tpu.client.transaction import QueueModel
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+    from foundationdb_tpu.runtime.core import DeterministicRandom
+
+    t = [0.0]
+    qm = QueueModel(clock=lambda: t[0])
+
+    class _Ref:
+        def __init__(self, i):
+            self.endpoint = Endpoint(NetworkAddress(f"1.0.0.{i}", 1), f"tok{i}")
+
+    fast, slow = _Ref(1), _Ref(2)
+    members = [{"getvalue": fast}, {"getvalue": slow}]
+    for _ in range(20):
+        qm.on_start(fast)
+        qm.on_reply(fast, 0.001)
+        qm.on_start(slow)
+        qm.on_reply(slow, 0.2)
+    rng = DeterministicRandom(5)
+    picks = [qm.pick(rng, members, "getvalue") for _ in range(50)]
+    assert picks.count(fast) > 45  # two-choice pick lands on the fast one
+
+    # a broken endpoint is avoided while its penalty lasts, then forgiven
+    qm.on_broken(fast)
+    picks = [qm.pick(rng, members, "getvalue") for _ in range(50)]
+    assert picks.count(slow) > 45
+    t[0] = 2.0  # penalty expired
+    picks = [qm.pick(rng, members, "getvalue") for _ in range(50)]
+    assert picks.count(fast) > 45
