@@ -42,11 +42,14 @@ from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
 from ..conflict.device import (
     _SENT_WORD,
     FAST_SEARCH_ITERS,
+    compact_lsm,
     host_bucket_index,
     impl_from_env,
     pack_batch,
     resolve_core,
+    resolve_core_lsm,
 )
+from ..ops.rmq import build_sparse_table
 from ..ops.rmq import _levels
 from ..ops.search import lex_less
 
@@ -111,12 +114,100 @@ def _sharded_resolve(
     return merged, new_ks[None], new_vs[None], new_count[None], new_bidx[None], all_conv, all_ok
 
 
+def _sharded_resolve_lsm(
+    ks, vs, tab, bidx, cnt,            # main level shards
+    rks, rvs, rbidx, rcnt,             # recent level shards
+    lo, hi,
+    rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    ok_in,
+    *, cap, rec_cap, n_txn, n_read, n_write, search_iters, rec_iters,
+    search_impl, merge_impl,
+):
+    """LSM twin of _sharded_resolve: per-partition two-level state, the
+    same clip → kernel → pmin shape (conflict/device.py resolve_core_lsm)."""
+    ks, vs, tab, bidx = ks[0], vs[0], tab[0], bidx[0]
+    rks, rvs, rbidx = rks[0], rvs[0], rbidx[0]
+    lo, hi = lo[0], hi[0]
+    rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
+    wb, we, w_tx = _clip_ranges(wb, we, w_tx, lo, hi)
+    verdict, nrks, nrvs, nrbidx, nrcnt, conv, ok = resolve_core_lsm(
+        ks, vs, tab, bidx, cnt[0],
+        rks, rvs, rbidx, rcnt[0],
+        rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off, ok_in,
+        cap=cap, rec_cap=rec_cap, n_txn=n_txn, n_read=n_read,
+        n_write=n_write, search_iters=search_iters, rec_iters=rec_iters,
+        search_impl=search_impl, merge_impl=merge_impl,
+    )
+    merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
+    all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
+    all_ok = jax.lax.pmin(ok.astype(jnp.int32), RESOLVER_AXIS) > 0
+    return (
+        merged, nrks[None], nrvs[None], nrbidx[None], nrcnt[None],
+        all_conv, all_ok,
+    )
+
+
+def _sharded_compact(ks, vs, rks, rvs, *, cap):
+    """Per-partition compact_lsm under shard_map (every partition folds its
+    recent level at once — the host triggers when any is near full)."""
+    nks, nvs, ncnt, nbidx, ntab = compact_lsm(
+        ks[0], vs[0], rks[0], rvs[0], cap=cap
+    )
+    return nks[None], nvs[None], ncnt[None], nbidx[None], ntab[None]
+
+
+def build_sharded_resolver_lsm(
+    mesh: Mesh, *, cap: int, rec_cap: int, n_txn: int, n_read: int,
+    n_write: int, search_iters: int, rec_iters: int,
+    search_impl: str, merge_impl: str,
+):
+    shard = P(RESOLVER_AXIS)
+    repl = P()
+    fn = jax.shard_map(
+        functools.partial(
+            _sharded_resolve_lsm, cap=cap, rec_cap=rec_cap, n_txn=n_txn,
+            n_read=n_read, n_write=n_write, search_iters=search_iters,
+            rec_iters=rec_iters, search_impl=search_impl,
+            merge_impl=merge_impl,
+        ),
+        mesh=mesh,
+        in_specs=(shard,) * 9 + (shard, shard) + (repl,) * 10,
+        out_specs=(repl, shard, shard, shard, shard, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def build_sharded_compactor(mesh: Mesh, *, cap: int):
+    shard = P(RESOLVER_AXIS)
+    fn = jax.shard_map(
+        functools.partial(_sharded_compact, cap=cap),
+        mesh=mesh,
+        in_specs=(shard,) * 4,
+        out_specs=(shard,) * 5,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
 @jax.jit
 def _sharded_gc(vs, off):
     """remove_before on the sharded gap-version array: elementwise rebase,
     so the output inherits the input's sharding — compiled once, offset is
     a runtime argument (same pattern as conflict/device.py _gc_kernel)."""
     return jnp.maximum(vs - off, 0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _sharded_gc_lsm(vs, tab, rec_vs, off):
+    """Fused, donating GC for the LSM levels (the _gc_lsm_kernel twin): one
+    dispatch, in-place — tab is the largest array in the system and must
+    not be transiently doubled."""
+    return (
+        jnp.maximum(vs - off, 0),
+        jnp.maximum(tab - off, 0),
+        jnp.maximum(rec_vs - off, 0),
+    )
 
 
 def build_sharded_resolver(
@@ -165,9 +256,18 @@ class ShardedDeviceConflictSet(ConflictSet):
         capacity: int = 1 << 14,
         merge_impl: str | None = None,
         search_impl: str | None = None,
+        lsm: bool | None = None,         # None: FDBTPU_LSM env ("1") or False
+        recent_capacity: int = 1 << 12,  # LSM recent level per partition
     ) -> None:
         self._merge_impl = impl_from_env("merge", merge_impl)
         self._search_impl = impl_from_env("search", search_impl)
+        import os
+
+        self._lsm = (
+            os.environ.get("FDBTPU_LSM", "") == "1" if lsm is None else lsm
+        )
+        self._rec_cap = recent_capacity
+        self.compactions = 0
         n = mesh.devices.size
         if len(split_keys) != n - 1:
             raise ValueError(f"need {n - 1} split keys for {n} resolver devices")
@@ -224,6 +324,88 @@ class ShardedDeviceConflictSet(ConflictSet):
         # word0-prefix bucket index per partition (sentinels -> last bucket)
         bidx = np.stack([host_bucket_index(nks[i]) for i in range(n)])
         self._bidx = dev(bidx)
+        if self._lsm:
+            # cached per-partition main sparse table + a fresh recent level
+            self._tab = jax.jit(
+                jax.vmap(lambda v: build_sparse_table(v, jnp.maximum, 0)),
+                out_shardings=self._state_sharding,
+            )(self._vs)
+            self._init_recent()
+
+    def _init_recent(self) -> None:
+        n, W, rec_cap = self._n, self._W, self._rec_cap
+        rk = np.full((n, rec_cap, W), _SENT_WORD, dtype=np.uint32)
+        rk[:, 0, :] = self._np_lo  # each partition's floor row
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        self._rec_ks = dev(rk)
+        self._rec_vs = dev(np.zeros((n, rec_cap), dtype=np.int32))
+        self._rec_bidx = dev(
+            np.stack([host_bucket_index(rk[i]) for i in range(n)])
+        )
+        self._rec_counts_ub = np.ones(self._n, dtype=np.int64)
+        self._rec_dev_counts = dev(np.ones(n, dtype=np.int32))
+
+    def _grow_recent(self, new_rec_cap: int) -> None:
+        """Sentinel-pad the recent level in place — no fold, no main-level
+        work (the single-device twin's _grow_recent)."""
+        n, W = self._n, self._W
+        rk = np.asarray(self._rec_ks)
+        rv = np.asarray(self._rec_vs)
+        nks = np.full((n, new_rec_cap, W), _SENT_WORD, dtype=np.uint32)
+        nks[:, : rk.shape[1]] = rk
+        nvs = np.zeros((n, new_rec_cap), dtype=np.int32)
+        nvs[:, : rv.shape[1]] = rv
+        counts, ub = self._rec_dev_counts, self._rec_counts_ub
+        self._rec_cap = new_rec_cap
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        self._rec_ks, self._rec_vs = dev(nks), dev(nvs)
+        self._rec_bidx = dev(
+            np.stack([host_bucket_index(nks[i]) for i in range(n)])
+        )
+        self._rec_dev_counts = counts
+        self._rec_counts_ub = ub
+
+    def _compact(self) -> None:
+        """Fold every partition's recent level into its main level; regrow
+        main if any partition's union no longer fits."""
+        while True:
+            key = ("compact", self._cap, self._rec_cap)
+            if key not in self._fns:
+                self._fns[key] = build_sharded_compactor(
+                    self._mesh, cap=self._cap
+                )
+            nks, nvs, ncnt, nbidx, ntab = self._fns[key](
+                self._ks, self._vs, self._rec_ks, self._rec_vs
+            )
+            counts = np.asarray(ncnt).astype(np.int64)
+            if counts.max() <= self._cap:
+                break
+            self.regrows += 1
+            new_cap = self._cap
+            while new_cap < counts.max():
+                new_cap *= 2
+            self._grow_main(new_cap)
+        self._ks, self._vs, self._bidx, self._tab = nks, nvs, nbidx, ntab
+        self._counts = counts
+        self._counts_ub = counts.copy()
+        self._dev_counts = ncnt
+        self._init_recent()
+        self.compactions += 1
+
+    def _grow_main(self, new_cap: int) -> None:
+        """Pad main to new_cap (compaction retry).  The caller's compactor
+        rebuilds bidx/tab from the folded result, so only ks/vs grow here."""
+        n, W = self._n, self._W
+        ks = np.asarray(self._ks)
+        vs = np.asarray(self._vs)
+        nks = np.full((n, new_cap, W), _SENT_WORD, dtype=np.uint32)
+        nks[:, : ks.shape[1]] = ks
+        nvs = np.zeros((n, new_cap), dtype=np.int32)
+        nvs[:, : vs.shape[1]] = vs
+        self._cap = new_cap
+        self._fns = {}
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        self._ks, self._vs = dev(nks), dev(nvs)
 
     @property
     def oldest_version(self) -> int:
@@ -245,6 +427,21 @@ class ShardedDeviceConflictSet(ConflictSet):
                 self._mesh, cap=self._cap, n_txn=n_txn, n_read=n_read,
                 n_write=n_write, search_iters=search_iters,
                 merge_impl=self._merge_impl, search_impl=self._search_impl,
+            )
+        return self._fns[key]
+
+    def _fn_lsm(self, n_txn: int, n_read: int, n_write: int,
+                search_iters: int, rec_iters: int):
+        key = (
+            "lsm", self._cap, self._rec_cap, n_txn, n_read, n_write,
+            search_iters, rec_iters, self._merge_impl, self._search_impl,
+        )
+        if key not in self._fns:
+            self._fns[key] = build_sharded_resolver_lsm(
+                self._mesh, cap=self._cap, rec_cap=self._rec_cap,
+                n_txn=n_txn, n_read=n_read, n_write=n_write,
+                search_iters=search_iters, rec_iters=rec_iters,
+                search_impl=self._search_impl, merge_impl=self._merge_impl,
             )
         return self._fns[key]
 
@@ -290,6 +487,12 @@ class ShardedDeviceConflictSet(ConflictSet):
         Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
         commit_off = np.int32(self._offset(commit_version))
         fast_iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+
+        if self._lsm:
+            return self._resolve_arrays_lsm(
+                commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p,
+                active_p, sync, Bp, R, Wn, commit_off,
+            )
 
         if not sync:
             # a batch adds at most 2*Wn boundaries per partition; if the
@@ -356,6 +559,73 @@ class ShardedDeviceConflictSet(ConflictSet):
             )
         return np.asarray(verdict)
 
+    def _resolve_arrays_lsm(
+        self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+        sync, Bp, R, Wn, commit_off,
+    ):
+        from ..conflict.device import _bucket
+
+        if 2 * Wn + 1 > self._rec_cap:
+            # a single batch larger than the recent level: pad recent in
+            # place (power-of-two, so jit cache keys stay bounded — the
+            # single-device _grow_recent contract)
+            self._grow_recent(_bucket(4 * Wn + 2))
+        if self._rec_counts_ub.max() + 2 * Wn > self._rec_cap:
+            # conservative ub: drain the exact counts first — clipping +
+            # coalescing usually keep the real counts far below it
+            self.check_pipelined()
+            if self._rec_counts_ub.max() + 2 * Wn > self._rec_cap:
+                self._compact()
+        fast_iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+        rec_iters = min(FAST_SEARCH_ITERS, _levels(self._rec_cap) + 1)
+
+        if not sync:
+            fn = self._fn_lsm(Bp, R, Wn, fast_iters, rec_iters)
+            verdict, nrks, nrvs, nrbidx, nrcnt, _conv, ok = fn(
+                self._ks, self._vs, self._tab, self._bidx, self._dev_counts,
+                self._rec_ks, self._rec_vs, self._rec_bidx, self._rec_dev_counts,
+                self._lo, self._hi,
+                rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                commit_off, self._dev_ok,
+            )
+            self._rec_ks, self._rec_vs = nrks, nrvs
+            self._rec_bidx, self._rec_dev_counts = nrbidx, nrcnt
+            self._dev_ok = ok
+            self._rec_counts_ub = self._rec_counts_ub + 2 * Wn
+            self._pipelined_since_check += 1
+            self._last_commit = commit_version
+            return verdict
+
+        iters, riters = fast_iters, rec_iters
+        while True:
+            fn = self._fn_lsm(Bp, R, Wn, iters, riters)
+            verdict, nrks, nrvs, nrbidx, nrcnt, conv, _ok = fn(
+                self._ks, self._vs, self._tab, self._bidx, self._dev_counts,
+                self._rec_ks, self._rec_vs, self._rec_bidx, self._rec_dev_counts,
+                self._lo, self._hi,
+                rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                commit_off, self._dev_ok,
+            )
+            if bool(np.asarray(conv)):
+                break
+            self.search_fallbacks += 1
+            iters = _levels(self._cap) + 1
+            riters = _levels(self._rec_cap) + 1
+        rcounts = np.asarray(nrcnt).astype(np.int64)
+        if rcounts.max() > self._rec_cap:
+            # coalescing estimate beaten: compact (pre-batch recent intact —
+            # the kernel does not donate) and replay this batch
+            self._compact()
+            return self._resolve_arrays_lsm(
+                commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p,
+                active_p, sync, Bp, R, Wn, commit_off,
+            )
+        self._rec_ks, self._rec_vs = nrks, nrvs
+        self._rec_bidx, self._rec_dev_counts = nrbidx, nrcnt
+        self._rec_counts_ub = rcounts.copy()
+        self._last_commit = commit_version
+        return np.asarray(verdict)
+
     def check_pipelined(self) -> None:
         """Drain the deferred validity of sync=False resolves (ONE replicated
         device flag + the live counts).  Raises if any batch needed the
@@ -372,8 +642,11 @@ class ShardedDeviceConflictSet(ConflictSet):
                 f"a pipelined batch among the last {n} failed its deferred"
                 " search-convergence/capacity check; replay through sync=True"
             )
-        self._counts = np.asarray(self._dev_counts).astype(np.int64)
-        self._counts_ub = self._counts.copy()
+        if self._lsm:
+            self._rec_counts_ub = np.asarray(self._rec_dev_counts).astype(np.int64)
+        else:
+            self._counts = np.asarray(self._dev_counts).astype(np.int64)
+            self._counts_ub = self._counts.copy()
 
     def remove_before(self, version: int) -> None:
         if version <= self._oldest:
@@ -381,5 +654,12 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._oldest = version
         off = version - self._base
         if off > 0:
-            self._vs = _sharded_gc(self._vs, np.int32(off))
+            if self._lsm:
+                # range-max commutes with the monotone clamp: the cached
+                # tables clamp in place, exactly like the single-device set
+                self._vs, self._tab, self._rec_vs = _sharded_gc_lsm(
+                    self._vs, self._tab, self._rec_vs, np.int32(off)
+                )
+            else:
+                self._vs = _sharded_gc(self._vs, np.int32(off))
             self._base = version

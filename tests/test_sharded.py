@@ -191,3 +191,73 @@ def test_sharded_pipelined_stream(mesh):
     for got_dev, want, n in zip(outs, wants, lens):
         got = [Verdict(int(c)) for c in np.asarray(got_dev)[:n]]
         assert got == want
+
+
+# -- LSM (two-level) state on the mesh: per-partition main+recent with
+# sharded compaction (parallel/sharded.py _sharded_resolve_lsm) -------------
+
+
+def test_sharded_lsm_matches_multi_oracle(mesh):
+    rng = random.Random(21)
+    dev = ShardedDeviceConflictSet(mesh, SPLITS, capacity=1 << 9, lsm=True,
+                                   recent_capacity=64)
+    ref = MultiOracle(SPLITS)
+    version = 0
+    for _ in range(30):
+        version += rng.randrange(1, 5)
+        txns = [random_tx(rng, max(version - 8, 0), version - 1)
+                for _ in range(rng.randrange(1, 9))]
+        got = dev.resolve_batch(version, txns)
+        want = ref.resolve_batch(version, txns)
+        assert got == want, f"at version {version}: {got} != {want}"
+    # fold recent into main explicitly, then parity must still hold
+    dev._compact()
+    version += 1
+    txns = [random_tx(rng, max(version - 8, 0), version - 1) for _ in range(6)]
+    assert dev.resolve_batch(version, txns) == ref.resolve_batch(version, txns)
+    assert dev.compactions >= 1
+
+
+def test_sharded_lsm_gc_and_compaction_interleave(mesh):
+    rng = random.Random(22)
+    dev = ShardedDeviceConflictSet(mesh, SPLITS, capacity=1 << 9, lsm=True,
+                                   recent_capacity=64)
+    ref = MultiOracle(SPLITS)
+    version = 0
+    for i in range(40):
+        version += rng.randrange(1, 4)
+        txns = [random_tx(rng, max(version - 6, dev.oldest_version), version - 1)
+                for _ in range(rng.randrange(1, 7))]
+        got = dev.resolve_batch(version, txns)
+        want = ref.resolve_batch(version, txns)
+        assert got == want, f"v{version}: {got} != {want}"
+        if i % 12 == 11:
+            floor = version - 3
+            dev.remove_before(floor)
+            ref.remove_before(floor)
+
+
+def test_sharded_lsm_pipelined_stream(mesh):
+    import numpy as np
+    from foundationdb_tpu.conflict.device import pack_batch
+
+    rng = random.Random(23)
+    dev = ShardedDeviceConflictSet(mesh, SPLITS, capacity=1 << 9, lsm=True,
+                                   recent_capacity=128)
+    ref = MultiOracle(SPLITS)
+    version = 0
+    pending = []
+    for i in range(30):
+        version += rng.randrange(1, 4)
+        txns = [random_tx(rng, max(version - 8, 0), version - 1)
+                for _ in range(rng.randrange(1, 7))]
+        want = ref.resolve_batch(version, txns)
+        packed = pack_batch(txns, dev._oldest, dev._offset, dev._max_key_bytes)
+        got_dev = dev.resolve_arrays(version, *packed[:8], sync=False)
+        pending.append((got_dev, want, len(txns)))
+        if i % 9 == 8:
+            dev.check_pipelined()
+    dev.check_pipelined()
+    for got_dev, want, B in pending:
+        got = [Verdict(int(c)) for c in np.asarray(got_dev)[:B]]
+        assert got == want
