@@ -40,6 +40,7 @@ from ..runtime.core import (
 )
 from ..runtime.trace import g_trace_batch
 from ..keys import key_after
+from ..runtime.coverage import testcov
 
 # errors a client retry loop may transparently retry (the onError set,
 # NativeAPI.actor.cpp:2543 — not_committed / transaction_too_old /
@@ -288,6 +289,7 @@ class Transaction:
         if isinstance(e, CommitUnknownResult) and self._write_ranges:
             fence = _intersect_ranges(self._write_ranges, self._read_ranges)
             if fence is not None:
+                testcov("client.unknown_result_fence")
                 await self._commit_fence(fence[0])
         await self.db.loop.delay(self._backoff * (0.5 + self.db._rng.random()))
         self._backoff = min(self._backoff * 2, 1.0)

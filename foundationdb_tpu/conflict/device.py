@@ -63,6 +63,7 @@ from .. import keys as keymod
 from ..ops.rmq import I32_MAX, _levels, build_sparse_table, query_sparse_table
 from ..ops.search import lex_less
 from .api import ConflictSet, TxInfo, Verdict, validate_batch
+from ..runtime.coverage import testcov
 
 _SENT_WORD = np.uint32(0xFFFFFFFF)
 
@@ -991,6 +992,7 @@ class DeviceConflictSet(ConflictSet):
                 # shared-prefix keys): replay at full search depth — the
                 # kernel is pure, so the replay is exact
                 self.search_fallbacks += 1
+                testcov("kernel.search_fallback")
                 iters = _levels(self._cap) + 1
             new_count_i = int(new_count)
             if new_count_i <= self._cap:
@@ -1055,6 +1057,7 @@ class DeviceConflictSet(ConflictSet):
             if bool(conv):
                 break
             self.search_fallbacks += 1
+            testcov("kernel.search_fallback")
             iters = _levels(self._cap) + 1
             rec_iters = _levels(self._rec_cap) + 1
         nrc_i = int(nrc)
@@ -1089,6 +1092,7 @@ class DeviceConflictSet(ConflictSet):
         self._dev_count = jnp.int32(nc_i)
         self._init_recent(self._rec_cap)
         self.compactions += 1
+        testcov("kernel.lsm_compaction")
 
     def _grow_main(self, new_cap: int) -> None:
         ks = np.asarray(self._ks)

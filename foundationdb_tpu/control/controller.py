@@ -51,6 +51,7 @@ from ..runtime.combinators import wait_all, wait_any
 from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
+from ..runtime.coverage import testcov
 
 
 class RecoveryState:
@@ -295,6 +296,7 @@ class ClusterController:
                      "n_tlogs": self.n_tlogs, "tlog_paths": gen.tlog_paths}
                 )
                 if not ok:
+                    testcov("recovery.lost_cstate_race")
                     self._teardown_generation(gen)
                     raise RuntimeError("lost cstate race: a newer master exists")
             if self.fs is not None:
@@ -338,6 +340,7 @@ class ClusterController:
                 )
                 reply = self._read_tlog_file(path)
                 if reply is not None:
+                    testcov("recovery.tlog_disk_fallback")
                     replies.append(reply)
                     continue
             replies.append(None)  # that TLog is gone
@@ -1073,6 +1076,7 @@ class ClusterController:
                 p.install_resolver_splits(new_splits, vm)
             self.resolver_splits = new_splits
             self.resolver_moves += 1
+            testcov("resolver.rebalance_move")
             self.trace.trace(
                 "ResolverRebalance", From=hi, To=lo, Epoch=self.epoch,
                 SplitKey=repr(key), EffectiveVersion=vm,
@@ -1210,6 +1214,7 @@ class ClusterController:
                 self.trace.trace(
                     "MasterRecoveryTriggered", Dead=dead, Epoch=self.epoch,
                 )
+                testcov("recovery.triggered")
                 try:
                     await self._recover()
                 except Exception as e:  # noqa: BLE001 — transient quorum

@@ -36,6 +36,7 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
+from ..runtime.coverage import testcov
 
 WLT_SS_PING = "wlt:ss_ping"
 
@@ -184,11 +185,13 @@ class DataDistributor:
                 f.cancel()
             new_ss.process.kill()
             new_ss.stop()
+            testcov("dd.heal_retry")
             cc.trace.trace("DDHealRetry", Tag=tag)
             return
         for view in cc.views:
             cc._fill_view(view)
         self.heals += 1
+        testcov("dd.healed")
         cc.trace.trace(
             "DDHealed", Tag=tag, Ranges=len(ranges), StartVersion=start_v,
         )
@@ -227,6 +230,7 @@ class DataDistributor:
             moved = await self.move_range(key, e, list(teams[cold]))
             if moved:
                 self.shard_splits += 1
+                testcov("dd.shard_split")
                 cc.trace.trace(
                     "DDShardSplit", SplitKey=repr(key), From=hot, To=cold,
                     HotKeys=sizes[hot],
@@ -349,6 +353,7 @@ class DataDistributor:
             await self.loop.delay(0.1, TaskPriority.COORDINATION)
         await cc.persist_key_servers(new_splits, final_teams)
         self.moves += 1
+        testcov("dd.move_complete")
         cc.trace.trace(
             "DDMoveComplete", Begin=repr(begin), End=repr(end),
             Dest=dest_team, Boundary=vm,

@@ -45,6 +45,7 @@ from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority,
 from ..runtime.knobs import CoreKnobs
 from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.trace import CounterCollection, g_trace_batch
+from ..runtime.coverage import testcov
 
 
 class KeyPartitionMap:
@@ -350,6 +351,7 @@ class CommitProxy:
         window = self.knobs.MAX_VERSIONS_IN_FLIGHT
         if self.committed_version.get() < version - window:
             self.c_throttled.add(1)
+            testcov("proxy.mvcc_window_throttle")
         while self.committed_version.get() < version - window:
             await wait_any(
                 [
@@ -542,6 +544,7 @@ class CommitProxy:
                 )
                 if live and refreshed:
                     break
+                testcov("proxy.grv_parked")
                 # Park, don't drop: the TLogs may be transiently unreachable
                 # (recovery in flight).  If this proxy is genuinely deposed its
                 # tasks are cancelled by stop() and the waiting clients time

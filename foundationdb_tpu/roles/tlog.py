@@ -36,6 +36,7 @@ from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
+from ..runtime.coverage import testcov
 from ..runtime.serialize import (
     BinaryReader,
     BinaryWriter,
@@ -214,6 +215,7 @@ class TLog:
             del offs[first : first + take]
             self._mem_bytes -= sum(n for _v, _o, n in spill)
             self.spill_events += 1
+            testcov("tlog.spilled")
 
     def _read_spilled(self, tag: str, entries) -> list[tuple[Version, list]]:
         out = []

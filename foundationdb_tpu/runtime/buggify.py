@@ -35,6 +35,15 @@ def is_enabled() -> bool:
 
 
 def buggify(site: str) -> bool:
+    fired = _buggify(site)
+    if fired:
+        from .coverage import testcov
+
+        testcov(f"buggify.{site}")
+    return fired
+
+
+def _buggify(site: str) -> bool:
     """True rarely, only in simulation.  `site` identifies the call site."""
     if _rng is None:
         return False

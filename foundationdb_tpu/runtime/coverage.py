@@ -1,0 +1,29 @@
+"""Rare-path coverage accounting — the coveragetool / TEST() macro analog
+(flow/UnitTest.h TEST(); the reference's coveragetool scrapes TEST("...")
+sites and simulation asserts they were all hit across a test campaign).
+
+Code marks a rare-but-important path with `testcov("name")`.  Counters are
+process-global and cheap (a dict increment); seed-sweep tests assert that
+the paths a campaign is supposed to exercise actually fired — the defense
+against fault-injection code that silently stops injecting."""
+
+from __future__ import annotations
+
+_hits: dict[str, int] = {}
+
+
+def testcov(name: str) -> None:
+    """Mark a rare-path execution (the TEST("name") macro)."""
+    _hits[name] = _hits.get(name, 0) + 1
+
+
+def hits(name: str) -> int:
+    return _hits.get(name, 0)
+
+
+def all_hits() -> dict[str, int]:
+    return dict(_hits)
+
+
+def reset() -> None:
+    _hits.clear()

@@ -50,8 +50,26 @@ from ..runtime.core import EventLoop, Future, TaskPriority, TimedOut
 _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<QB")  # req_id, op
 
-OK, ERR_NOT_COMMITTED, ERR_TOO_OLD, ERR_UNKNOWN_RESULT, ERR_FUTURE_VERSION, \
-    ERR_TIMED_OUT, ERR_BAD_REQUEST, ERR_INTERNAL = 0, 1, 2, 3, 4, 5, 6, 255
+# the single source of truth for ABI status codes: the ABI constants AND
+# the vexillographer's generated table both derive from this dict
+STATUS_CODES = {
+    "ok": 0,
+    "not_committed": 1,
+    "transaction_too_old": 2,
+    "commit_unknown_result": 3,
+    "future_version": 4,
+    "timed_out": 5,
+    "bad_request": 6,
+    "internal_error": 255,
+}
+OK = STATUS_CODES["ok"]
+ERR_NOT_COMMITTED = STATUS_CODES["not_committed"]
+ERR_TOO_OLD = STATUS_CODES["transaction_too_old"]
+ERR_UNKNOWN_RESULT = STATUS_CODES["commit_unknown_result"]
+ERR_FUTURE_VERSION = STATUS_CODES["future_version"]
+ERR_TIMED_OUT = STATUS_CODES["timed_out"]
+ERR_BAD_REQUEST = STATUS_CODES["bad_request"]
+ERR_INTERNAL = STATUS_CODES["internal_error"]
 
 _ERR_CODE = {
     NotCommitted: ERR_NOT_COMMITTED,

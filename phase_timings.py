@@ -139,6 +139,66 @@ def main() -> None:
     print(f"  intra fixpoint  {s['search+hist+intra'] - s['search+hist']:9.1f} ms")
     print(f"  merge+buckets   {s['FULL kernel'] - s['search+hist+intra']:9.1f} ms")
 
+    # ---- LSM path: full kernel + amortized compaction --------------------
+    ldev = D.DeviceConflictSet(
+        max_key_bytes=B.MAX_KEY_BYTES, capacity=B.CAP, lsm=True,
+        recent_capacity=B.REC_CAP,
+    )
+    t0 = time.perf_counter()
+    for b in prefill:
+        ldev.resolve_arrays(b["version"], *B.device_pack(pool_words, b, B._bucket))
+    print(f"\nLSM prefill {time.perf_counter() - t0:.1f}s "
+          f"(compactions: {ldev.compactions})", flush=True)
+
+    lfull = functools.partial(
+        jax.jit,
+        static_argnames=("cap", "rec_cap", "n_txn", "n_read", "n_write",
+                         "search_iters", "rec_iters", "search_impl",
+                         "merge_impl"),
+    )(D.resolve_core_lsm)
+
+    @jax.jit
+    def t_lsm(ks, vs, tab, bidx, count, rks, rvs, rbidx, rcnt):
+        verdict, nrk, nrv, nrb, nrc, conv, ok = lfull(
+            ks, vs, tab, bidx, count, rks, rvs, rbidx, rcnt,
+            rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
+            cap=B.CAP, rec_cap=ldev._rec_cap, n_txn=Bp, n_read=R, n_write=Wn,
+        )
+        return verdict.sum() + nrc
+
+    lst = (ldev._ks, ldev._vs, ldev._tab, ldev._bidx, ldev._dev_count,
+           ldev._rec_ks, ldev._rec_vs, ldev._rec_bidx, ldev._rec_dev_count)
+    fetch(t_lsm(*lst))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fetch(t_lsm(*lst))
+        ts.append(time.perf_counter() - t0)
+    lsm_ms = sorted(ts)[2] * 1e3 - rtt
+    print(f"  LSM FULL (no compact)  {lsm_ms:9.1f} ms", flush=True)
+
+    comp = functools.partial(jax.jit, static_argnames=("cap",))(D.compact_lsm)
+
+    @jax.jit
+    def t_comp(ks, vs, rks, rvs):
+        nks, nvs, nc, nb, nt = comp(ks, vs, rks, rvs, cap=B.CAP)
+        return nc + nb[0] + nt[0, 0]
+
+    cst = (ldev._ks, ldev._vs, ldev._rec_ks, ldev._rec_vs)
+    fetch(t_comp(*cst))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fetch(t_comp(*cst))
+        ts.append(time.perf_counter() - t0)
+    comp_ms = sorted(ts)[1] * 1e3 - rtt
+    batches_per_compact = max((B.REC_CAP - 1) // (2 * Wn), 1)
+    print(f"  LSM compaction         {comp_ms:9.1f} ms "
+          f"(/{batches_per_compact} batches = "
+          f"{comp_ms / batches_per_compact:.1f} ms amortized)", flush=True)
+    print(f"  LSM effective/batch    {lsm_ms + comp_ms / batches_per_compact:9.1f} ms",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

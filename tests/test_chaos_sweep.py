@@ -101,3 +101,25 @@ def test_chaos_sites_actually_fire():
         c.stop()
         buggify.disable()
     assert fired, "no buggify site ever armed across the sweep"
+
+
+def test_sweep_covers_rare_paths():
+    """The coveragetool discipline (flow/UnitTest.h TEST() + the reference's
+    coveragetool): a chaos campaign must actually EXERCISE the rare paths
+    its fault injection exists to reach — if buggify stops firing or the
+    recovery path stops running, this fails loudly instead of the campaign
+    silently testing nothing."""
+    from foundationdb_tpu.runtime import coverage
+    from foundationdb_tpu.workloads.bank import BankWorkload
+
+    coverage.reset()
+    for seed in (1301, 1302, 1303):
+        c = RecoverableCluster(seed=seed, n_storage_shards=2, chaos=True)
+        bank = BankWorkload(accounts=6, clients=2, transfers_per_client=6)
+        att = AttritionWorkload(kills=1, interval=2.0, start_delay=0.8)
+        run_workloads(c, [bank, att], deadline=600.0)
+        c.stop()
+    hits = coverage.all_hits()
+    assert coverage.hits("recovery.triggered") >= 3  # one per seed's kill
+    # fault injection genuinely fired somewhere across the sweep
+    assert any(k.startswith("buggify.") for k in hits), hits

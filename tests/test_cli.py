@@ -35,3 +35,16 @@ def test_cli_scriptable_repl():
     text = out.getvalue()
     assert "committed" in text and repr(b"1") in text
     cli.cluster.stop()
+
+
+def test_vexillographer_doc_in_sync():
+    """The generated options/knobs surface must match the committed doc
+    (the vexillographer can-never-drift discipline)."""
+    import pathlib
+
+    from foundationdb_tpu.tools.vexillographer import generate
+
+    committed = (pathlib.Path(__file__).resolve().parent.parent / "KNOBS.md").read_text()
+    assert committed == generate(), (
+        "KNOBS.md is stale: run python -m foundationdb_tpu.tools.vexillographer"
+    )
