@@ -166,9 +166,13 @@ class Database:
         loop: EventLoop,
         view: ClusterView,
         rng: DeterministicRandom,
+        client_knobs=None,
     ) -> None:
+        from ..runtime.knobs import ClientKnobs
+
         self.loop = loop
         self.view = view
+        self.knobs = client_knobs or ClientKnobs()
         self._rng = rng.split()
         self._qm = QueueModel(loop.now)
         # fraction of transactions given a pipeline-timeline debug ID
@@ -259,7 +263,7 @@ class Transaction:
         self._read_ranges: list[tuple[bytes, bytes]] = []
         self._write_ranges: list[tuple[bytes, bytes]] = []
         self.committed_version: Version | None = None
-        self._backoff = 0.01  # carried across on_error resets
+        self._backoff = db.knobs.DEFAULT_BACKOFF  # carried across resets
         self.debug_id: str | None = None  # set by sampled create_transaction
 
     def reset(self) -> None:
@@ -292,7 +296,7 @@ class Transaction:
                 testcov("client.unknown_result_fence")
                 await self._commit_fence(fence[0])
         await self.db.loop.delay(self._backoff * (0.5 + self.db._rng.random()))
-        self._backoff = min(self._backoff * 2, 1.0)
+        self._backoff = min(self._backoff * 2, self.db.knobs.MAX_BACKOFF)
         self.reset()
 
     async def _commit_fence(self, key: bytes) -> None:
@@ -313,7 +317,7 @@ class Transaction:
                 )
         raise CommitUnknownResult("fence transaction could not commit")
 
-    async def _reply_rerouted(self, pick_ref, payload, timeout: float = 5.0):
+    async def _reply_rerouted(self, pick_ref, payload, timeout: float | None = None):
         """get_reply with fast re-route: a BrokenPromise (dead endpoint —
         the connection-reset analog) retries immediately against a freshly
         picked ref (the view is re-read, so a recovery's rewire takes
@@ -321,6 +325,8 @@ class Transaction:
         overall deadline surfaces, as TimedOut."""
         loop = self.db.loop
         qm = self.db._qm
+        if timeout is None:
+            timeout = self.db.knobs.REQUEST_TIMEOUT
         deadline = loop.now() + timeout
         while True:
             remaining = deadline - loop.now()
@@ -335,7 +341,10 @@ class Transaction:
                 return reply
             except BrokenPromise:
                 qm.on_broken(ref)
-                await loop.delay(min(0.05, max(deadline - loop.now(), 0.001)))
+                await loop.delay(
+                    min(self.db.knobs.REROUTE_DELAY,
+                        max(deadline - loop.now(), 0.001))
+                )
             except (TimedOut, ActorCancelled):
                 qm.on_abandon(ref)  # no reply observed: not a latency sample
                 raise
@@ -447,7 +456,9 @@ class Transaction:
         )
         g_trace_batch.add("NativeAPI.commit.Before", self.debug_id)
         try:
-            reply: CommitReply = await self.db._commit.get_reply(req, timeout=5.0)
+            reply: CommitReply = await self.db._commit.get_reply(
+                req, timeout=self.db.knobs.COMMIT_TIMEOUT
+            )
             g_trace_batch.add("NativeAPI.commit.After", self.debug_id)
         except TimedOut:
             # proxy unreachable: the commit may have happened

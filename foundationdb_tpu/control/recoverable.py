@@ -64,12 +64,16 @@ class RecoverableCluster:
         self.rng = DeterministicRandom(seed)
         from ..runtime import buggify as _buggify
 
+        from ..runtime.knobs import ClientKnobs
+
         if chaos:
             _buggify.enable(self.rng)
             self.knobs = knobs or CoreKnobs(randomize=self.rng)
+            self.client_knobs = ClientKnobs(randomize=self.rng)
         else:
             _buggify.disable()
             self.knobs = knobs or CoreKnobs()
+            self.client_knobs = ClientKnobs()
         self.trace = TraceCollector(clock=self.loop.now)
         from ..runtime.trace import g_trace_batch
 
@@ -313,7 +317,8 @@ class RecoverableCluster:
     def database(self) -> Database:
         proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")
         view = self.controller.make_view(proc)
-        return Database(self.loop, view, self.rng)
+        return Database(self.loop, view, self.rng,
+                        client_knobs=self.client_knobs)
 
     def run_until(self, fut, deadline: float | None = None):
         return self.loop.run_until(fut, deadline)

@@ -31,6 +31,7 @@ from __future__ import annotations
 import pickle
 import selectors
 import socket
+import ssl
 import struct
 import time as _time
 from typing import Any, Callable
@@ -43,6 +44,33 @@ _LEN = struct.Struct("<I")
 MAX_FRAME = 64 << 20
 
 
+class TLSConfig:
+    """Mutual TLS for the transport — the FDBLibTLS slot.  Every node
+    presents a certificate signed by the cluster CA and REQUIRES the same
+    of its peer (the reference's default verify-peers policy): a plaintext
+    or wrong-CA peer never completes a handshake, so the pickled-frames
+    trust boundary extends only to holders of a cluster cert."""
+
+    def __init__(self, certfile: str, keyfile: str, cafile: str) -> None:
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.cafile = cafile
+
+    def _ctx(self, purpose) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(purpose)
+        ctx.load_cert_chain(self.certfile, self.keyfile)
+        ctx.load_verify_locations(self.cafile)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.check_hostname = False  # identity = cluster CA, not hostnames
+        return ctx
+
+    def server_ctx(self) -> ssl.SSLContext:
+        return self._ctx(ssl.PROTOCOL_TLS_SERVER)
+
+    def client_ctx(self) -> ssl.SSLContext:
+        return self._ctx(ssl.PROTOCOL_TLS_CLIENT)
+
+
 class _Conn:
     """One peer connection: framed, buffered, non-blocking."""
 
@@ -52,6 +80,7 @@ class _Conn:
         self.out = bytearray()
         self.inbuf = bytearray()
         self.connecting = False
+        self.handshaking = False  # TLS handshake in progress
         self.dead = False
         # reply tokens of requests sent over this connection and not yet
         # answered: failed with BrokenPromise if the connection dies (the
@@ -100,8 +129,12 @@ class RealNetwork:
     The default bind is 127.0.0.1; binding wider is an explicit opt-in."""
 
     def __init__(self, loop: EventLoop, name: str = "proc",
-                 ip: str = "127.0.0.1", port: int = 0) -> None:
+                 ip: str = "127.0.0.1", port: int = 0,
+                 tls: TLSConfig | None = None) -> None:
         self.loop = loop
+        self.tls = tls
+        self._server_ctx = tls.server_ctx() if tls else None
+        self._client_ctx = tls.client_ctx() if tls else None
         self._sel = selectors.DefaultSelector()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -201,26 +234,77 @@ class RealNetwork:
                     continue
                 s.setblocking(False)
                 c = _Conn(s, None)
+                if self._server_ctx is not None:
+                    try:
+                        c.sock = self._server_ctx.wrap_socket(
+                            s, server_side=True, do_handshake_on_connect=False
+                        )
+                    except (ssl.SSLError, OSError):
+                        s.close()
+                        continue
+                    c.handshaking = True
                 self._sel.register(
-                    s, selectors.EVENT_READ, ("conn", c)
+                    c.sock, selectors.EVENT_READ | selectors.EVENT_WRITE,
+                    ("conn", c),
                 )
                 continue
-            if events & selectors.EVENT_WRITE:
+            if conn.connecting and (events & selectors.EVENT_WRITE):
                 conn.connecting = False
+                if self._client_ctx is not None:
+                    # TCP is up: start the TLS handshake (the selector must
+                    # track the NEW SSLSocket object wrapping the same fd)
+                    try:
+                        self._sel.unregister(conn.sock)
+                        conn.sock = self._client_ctx.wrap_socket(
+                            conn.sock, do_handshake_on_connect=False
+                        )
+                        self._sel.register(
+                            conn.sock,
+                            selectors.EVENT_READ | selectors.EVENT_WRITE,
+                            ("conn", conn),
+                        )
+                        conn.handshaking = True
+                    except (ssl.SSLError, OSError):
+                        self._drop_conn(conn)
+                        continue
+            if conn.handshaking:
+                self._pump_handshake(conn)
+                continue
+            if events & selectors.EVENT_WRITE:
                 self._try_flush(conn)
-                if not conn.out:
+                if not conn.out and not conn.dead:
                     self._sel.modify(conn.sock, selectors.EVENT_READ, ("conn", conn))
             if events & selectors.EVENT_READ:
                 self._read(conn)
 
+    def _pump_handshake(self, conn: _Conn) -> None:
+        try:
+            conn.sock.do_handshake()
+        except ssl.SSLWantReadError:
+            self._sel.modify(conn.sock, selectors.EVENT_READ, ("conn", conn))
+            return
+        except ssl.SSLWantWriteError:
+            self._sel.modify(conn.sock, selectors.EVENT_WRITE, ("conn", conn))
+            return
+        except (ssl.SSLError, OSError):
+            # wrong CA / plaintext peer / reset: sever (verify-peers policy)
+            self._drop_conn(conn)
+            return
+        conn.handshaking = False
+        self._sel.modify(
+            conn.sock, selectors.EVENT_READ | selectors.EVENT_WRITE,
+            ("conn", conn),
+        )
+        self._try_flush(conn)
+
     def _try_flush(self, conn: _Conn) -> None:
-        if conn.connecting or conn.dead:
+        if conn.connecting or conn.handshaking or conn.dead:
             return
         try:
             while conn.out:
                 n = conn.sock.send(conn.out)
                 del conn.out[:n]
-        except BlockingIOError:
+        except (BlockingIOError, ssl.SSLWantWriteError, ssl.SSLWantReadError):
             self._sel.modify(
                 conn.sock,
                 selectors.EVENT_READ | selectors.EVENT_WRITE,
@@ -230,10 +314,21 @@ class RealNetwork:
             self._drop_conn(conn)
 
     def _read(self, conn: _Conn) -> None:
+        data = bytearray()
         try:
-            data = conn.sock.recv(1 << 16)
-        except BlockingIOError:
-            return
+            while True:
+                chunk = conn.sock.recv(1 << 16)
+                if not chunk:
+                    break
+                data += chunk
+                # an SSLSocket may hold decrypted bytes beyond one recv
+                if not (isinstance(conn.sock, ssl.SSLSocket) and conn.sock.pending()):
+                    break
+        except (BlockingIOError, ssl.SSLWantReadError, ssl.SSLWantWriteError):
+            # SSLWantWrite on a READ is legal (renegotiation with a full
+            # send buffer) — benign, like the Want* cases in _try_flush
+            if not data:
+                return
         except OSError:
             self._drop_conn(conn)
             return

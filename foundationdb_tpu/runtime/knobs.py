@@ -31,6 +31,26 @@ class Knobs:
         return sorted(self._defs)
 
 
+class ClientKnobs(Knobs):
+    """Client-side tunables — the reference splits knobs into ClientKnobs
+    (fdbclient/Knobs.cpp) and ServerKnobs; these govern the NativeAPI
+    retry loop and request routing, not any server role."""
+
+    def __init__(self, randomize=None) -> None:
+        super().__init__()
+        r = randomize
+        # on_error retry backoff (reference DEFAULT_BACKOFF/BACKOFF_GROWTH_RATE)
+        self.init("DEFAULT_BACKOFF", 0.01 if r is None else 0.005 + r.random() * 0.02)
+        self.init("MAX_BACKOFF", 1.0)
+        # per-request deadline before the client re-routes / reports
+        # TimedOut (covers GRV, reads, watches)
+        self.init("REQUEST_TIMEOUT", 5.0)
+        # commit deadline: past it the result is UNKNOWN (the fence dance)
+        self.init("COMMIT_TIMEOUT", 5.0)
+        # pause before re-picking a replica after a dead endpoint
+        self.init("REROUTE_DELAY", 0.05)
+
+
 class CoreKnobs(Knobs):
     def __init__(self, randomize=None) -> None:
         super().__init__()

@@ -1,0 +1,138 @@
+"""Mutual-TLS transport (rpc/transport.py TLSConfig — the FDBLibTLS slot):
+cluster-CA-signed peers handshake and serve RPCs; plaintext and wrong-CA
+peers are severed by the verify-peers policy."""
+
+import subprocess
+import time as _time
+
+import pytest
+
+from foundationdb_tpu.roles.types import GetValueRequest  # any dataclass payload
+from foundationdb_tpu.rpc.stream import RequestStream, RequestStreamRef
+from foundationdb_tpu.rpc.transport import NetDriver, RealNetwork, TLSConfig
+from foundationdb_tpu.runtime.core import BrokenPromise, EventLoop, TimedOut
+
+
+def _mkcert(tmp, name, ca=None):
+    """Self-signed CA or CA-signed leaf via the openssl CLI."""
+    key, crt = tmp / f"{name}.key", tmp / f"{name}.crt"
+    if ca is None:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(crt), "-days", "1",
+             "-subj", f"/CN={name}"],
+            check=True, capture_output=True,
+        )
+    else:
+        ca_key, ca_crt = ca
+        csr = tmp / f"{name}.csr"
+        subprocess.run(
+            ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}"],
+            check=True, capture_output=True,
+        )
+        subprocess.run(
+            ["openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+             "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(crt),
+             "-days", "1"],
+            check=True, capture_output=True,
+        )
+    return key, crt
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    ca = _mkcert(tmp, "cluster-ca")
+    a = _mkcert(tmp, "node-a", ca=ca)
+    b = _mkcert(tmp, "node-b", ca=ca)
+    rogue_ca = _mkcert(tmp, "rogue-ca")
+    rogue = _mkcert(tmp, "rogue", ca=rogue_ca)
+    return {"ca": ca, "a": a, "b": b, "rogue_ca": rogue_ca, "rogue": rogue}
+
+
+def _tls(certs, who, ca="ca"):
+    key, crt = certs[who]
+    return TLSConfig(str(crt), str(key), str(certs[ca][1]))
+
+
+def _pump_until(drivers, fut, wall_timeout=20.0):
+    start = _time.monotonic()
+    while not fut.done():
+        if _time.monotonic() - start > wall_timeout:
+            raise TimedOut("tls test wall timeout")
+        for d in drivers:
+            d._tick()
+    return fut.result()
+
+
+def _echo_server(net, loop):
+    rs = RequestStream(net.process, "wlt:echo")
+
+    async def serve():
+        while True:
+            req = await rs.next()
+            req.reply(("echoed", req.payload))
+
+    loop.spawn(serve())
+
+
+def test_mtls_request_reply(certs):
+    loop_s, loop_c = EventLoop(), EventLoop()
+    server = RealNetwork(loop_s, name="server", tls=_tls(certs, "a"))
+    client = RealNetwork(loop_c, name="client", tls=_tls(certs, "b"))
+    try:
+        _echo_server(server, loop_s)
+        from foundationdb_tpu.rpc.network import Endpoint
+
+        ref = RequestStreamRef(
+            client, client.process, Endpoint(server.address, "wlt:echo")
+        )
+
+        async def ask():
+            return await ref.get_reply(GetValueRequest(b"k", 1), timeout=15.0)
+
+        fut = loop_c.spawn(ask())
+        kind, payload = _pump_until(
+            [NetDriver(loop_s, server), NetDriver(loop_c, client)], fut
+        )
+        assert kind == "echoed" and payload.key == b"k"
+    finally:
+        server.close()
+        client.close()
+
+
+@pytest.mark.parametrize("client_tls", ["plaintext", "rogue_ca"])
+def test_untrusted_client_rejected(certs, client_tls):
+    """Verify-peers policy: both a plaintext peer and one whose cert chains
+    to a DIFFERENT CA are severed before any frame is served."""
+    loop_s, loop_c = EventLoop(), EventLoop()
+    server = RealNetwork(loop_s, name="server", tls=_tls(certs, "a"))
+    client = RealNetwork(
+        loop_c, name="untrusted",
+        tls=None if client_tls == "plaintext"
+        else _tls(certs, "rogue", ca="rogue_ca"),
+    )
+    try:
+        _echo_server(server, loop_s)
+        from foundationdb_tpu.rpc.network import Endpoint
+
+        ref = RequestStreamRef(
+            client, client.process, Endpoint(server.address, "wlt:echo")
+        )
+
+        async def ask():
+            try:
+                await ref.get_reply(GetValueRequest(b"k", 1), timeout=3.0)
+                return "replied"
+            except (BrokenPromise, TimedOut) as e:
+                return type(e).__name__
+
+        fut = loop_c.spawn(ask())
+        out = _pump_until(
+            [NetDriver(loop_s, server), NetDriver(loop_c, client)], fut
+        )
+        assert out in ("BrokenPromise", "TimedOut")
+    finally:
+        server.close()
+        client.close()
