@@ -67,6 +67,9 @@ class ReadYourWritesTransaction:
         self._tr = db.create_transaction()
         self._wm = WriteMap()
 
+    def set_option(self, option: bytes, value: bytes | None = None) -> None:
+        self._tr.set_option(option, value)
+
     # -- reads (merged) ------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
         local = self._wm.lookup(key)

@@ -265,6 +265,34 @@ class Transaction:
         self.committed_version: Version | None = None
         self._backoff = db.knobs.DEFAULT_BACKOFF  # carried across resets
         self.debug_id: str | None = None  # set by sampled create_transaction
+        self._priority = 1  # TransactionPriority.DEFAULT
+        self._causal_write_risky = False
+
+    def set_option(self, option: bytes, value: bytes | None = None) -> None:
+        """Transaction options (fdb_transaction_set_option; the generated
+        surface the reference's vexillographer emits).  Supported:
+
+          priority_batch              yield to other traffic under load
+          priority_system_immediate   bypass ratekeeper admission
+          causal_write_risky          skip the self-conflict ranges that
+                                      make the unknown-result fence certain
+                                      (faster commits, weaker retry safety)
+          debug_transaction_identifier  value = id; join pipeline timelines
+        """
+        from ..roles.types import PRIORITY_BATCH, PRIORITY_IMMEDIATE
+
+        if option == b"priority_batch":
+            self._priority = PRIORITY_BATCH
+        elif option == b"priority_system_immediate":
+            self._priority = PRIORITY_IMMEDIATE
+        elif option == b"causal_write_risky":
+            self._causal_write_risky = True
+        elif option == b"debug_transaction_identifier":
+            if not value:
+                raise ValueError("debug_transaction_identifier needs a value")
+            self.debug_id = value.decode()
+        else:
+            raise ValueError(f"unknown transaction option {option!r}")
 
     def reset(self) -> None:
         """Clear all transaction state for a retry (fresh read version,
@@ -287,7 +315,9 @@ class Transaction:
         commit afterwards, so the retry cannot race a zombie commit into a
         double-apply.  The intersection always exists because commit()
         makes every transaction self-conflicting when its read and write
-        sets are disjoint."""
+        sets are disjoint — UNLESS the causal_write_risky option disabled
+        that, in which case the fence is skipped and a retried unknown-
+        result commit may double-apply (the option's documented trade)."""
         if not isinstance(e, RETRYABLE_ERRORS):
             raise e
         if isinstance(e, CommitUnknownResult) and self._write_ranges:
@@ -359,7 +389,9 @@ class Transaction:
                 "NativeAPI.getConsistentReadVersion.Before", self.debug_id
             )
             reply = await self._reply_rerouted(
-                lambda: self.db._grv, GetReadVersionRequest(debug_id=self.debug_id)
+                lambda: self.db._grv,
+                GetReadVersionRequest(debug_id=self.debug_id,
+                                      priority=self._priority),
             )
             self._read_version = reply.version
             g_trace_batch.add(
@@ -438,7 +470,10 @@ class Transaction:
             self.committed_version = self._read_version or 0
             return self.committed_version  # read-only: nothing to commit
         v = await self.get_read_version()
-        if _intersect_ranges(self._write_ranges, self._read_ranges) is None:
+        if (
+            not self._causal_write_risky
+            and _intersect_ranges(self._write_ranges, self._read_ranges) is None
+        ):
             # make the transaction self-conflicting (the reference's
             # makeSelfConflicting under !causalWriteRisky): gives on_error's
             # unknown-result fence a range that aborts the in-flight

@@ -36,6 +36,7 @@ class Ratekeeper:
         self.tlogs_fn = tlogs_fn
         self.max_tps = max_tps
         self.tps_budget = max_tps
+        self.batch_tps_budget = max_tps
         self.limit_reason = "unlimited"
         self.limiting_server: str | None = None
         self._lag_smoothers: dict[str, Smoother] = {}
@@ -106,6 +107,12 @@ class Ratekeeper:
 
         self._budget.set_total(tps)
         self.tps_budget = max(self._budget.smooth_total(), self.max_tps * 0.01)
+        # batch-priority budget (the reference's separate batch limit):
+        # batch traffic starves FIRST — it reaches zero while default-class
+        # work still has 25% of the full rate left
+        self.batch_tps_budget = max(
+            0.0, (self.tps_budget - 0.25 * self.max_tps) / 0.75
+        )
         self.limit_reason = reason
         self.limiting_server = limiting
 

@@ -22,6 +22,9 @@ import dataclasses
 from ..conflict.api import TxInfo, Verdict
 from .sequencer import NotifiedVersion
 from .types import (
+    PRIORITY_BATCH,
+    PRIORITY_DEFAULT,
+    PRIORITY_IMMEDIATE,
     CommitReply,
     CommitResult,
     CommitTransactionRequest,
@@ -129,6 +132,7 @@ class CommitProxy:
         self._req_num = 0
         self._failed = False
         self._grv_tokens = 10.0
+        self._grv_batch_tokens = 0.0
         self._grv_refill_at = loop.now()
         # multi-proxy plane: raw-version refs of the OTHER proxies (wired by
         # the controller after all proxies exist) and confirm refs to this
@@ -435,11 +439,22 @@ class CommitProxy:
     # -- GRV ------------------------------------------------------------------
     def _refill_grv_tokens(self, share: int = 1) -> None:
         now = self.loop.now()
+        dt = now - self._grv_refill_at
         rate = self.ratekeeper.tps_budget if self.ratekeeper else float("inf")
         rate /= max(share, 1)  # each proxy spends its slice of the budget
         self._grv_tokens = min(
-            self._grv_tokens + (now - self._grv_refill_at) * rate,
+            self._grv_tokens + dt * rate,
             max(rate * 0.1, 100.0),
+        )
+        # batch-priority bucket: fed by the ratekeeper's separate (harsher)
+        # batch budget; it can run dry entirely while default still flows
+        brate = (
+            self.ratekeeper.batch_tps_budget if self.ratekeeper else float("inf")
+        ) / max(share, 1)
+        # no burst floor: a zero batch budget must serve ZERO batch traffic
+        # (the cap also clamps stale tokens down when the budget collapses)
+        self._grv_batch_tokens = min(
+            self._grv_batch_tokens + dt * brate, brate * 0.1 + 0.999
         )
         self._grv_refill_at = now
 
@@ -518,18 +533,62 @@ class CommitProxy:
         batch.  Causally safe because committed versions only advance after
         all-TLog durability, and the liveness confirmation means no newer
         generation can have committed anything this proxy hasn't seen."""
+        pend_default: list = []  # (expiry, req) — parked by the throttle
+        pend_batch: list = []
         while True:
-            req = await self.grv_stream.next()
-            reqs = [req]
+            # drain arrivals; while throttled requests wait, poll instead of
+            # blocking so a starved class never wedges the other classes
+            if not pend_default and not pend_batch:
+                pend = [await self.grv_stream.next()]
+            else:
+                pend = []
+                if not len(self.grv_stream.requests):
+                    await self.loop.delay(0.005, TaskPriority.GET_LIVE_VERSION)
             while len(self.grv_stream.requests):
-                reqs.append(await self.grv_stream.next())
+                pend.append(await self.grv_stream.next())
+            now = self.loop.now()
+            reqs = []
+            for r in pend:
+                pri = getattr(r.payload, "priority", PRIORITY_DEFAULT)
+                if pri >= PRIORITY_IMMEDIATE:
+                    reqs.append(r)  # IMMEDIATE: bypasses admission control
+                elif pri == PRIORITY_BATCH:
+                    pend_batch.append((now + 6.0, r))
+                else:
+                    pend_default.append((now + 6.0, r))
+            # a parked request whose client has long since timed out and
+            # re-routed is garbage — drop it instead of growing forever
+            pend_default = [e for e in pend_default if e[0] > now]
+            pend_batch = [e for e in pend_batch if e[0] > now]
             if self.ratekeeper is not None:
                 share = 1 + len(self.peers)  # budget split across proxies
                 self._refill_grv_tokens(share)
-                while self._grv_tokens < len(reqs):
-                    await self.loop.delay(0.005, TaskPriority.GET_LIVE_VERSION)
-                    self._refill_grv_tokens(share)
-                self._grv_tokens -= len(reqs)
+                n = min(len(pend_default), int(self._grv_tokens))
+                if n:
+                    self._grv_tokens -= n
+                    reqs.extend(r for _e, r in pend_default[:n])
+                    del pend_default[:n]
+                # batch admissions count against BOTH budgets: the batch
+                # bucket is the class's (harsher) cap, the default bucket is
+                # the cluster-wide ceiling — total admitted rate can never
+                # exceed the ratekeeper's tps_budget
+                nb = min(
+                    len(pend_batch),
+                    int(min(self._grv_batch_tokens, self._grv_tokens)),
+                )
+                if nb:
+                    self._grv_batch_tokens -= nb
+                    self._grv_tokens -= nb
+                    reqs.extend(r for _e, r in pend_batch[:nb])
+                    del pend_batch[:nb]
+                if (pend_default or pend_batch) and not reqs:
+                    testcov("proxy.grv_throttled")
+            else:
+                reqs.extend(r for _e, r in pend_default)
+                reqs.extend(r for _e, r in pend_batch)
+                pend_default, pend_batch = [], []
+            if not reqs:
+                continue
             while True:
                 live, refreshed = await wait_all(
                     [

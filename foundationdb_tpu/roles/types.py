@@ -256,9 +256,16 @@ class ClusterRecovering(Exception):
 # ---- GRV ------------------------------------------------------------------
 
 
+# TransactionPriority (fdbclient/FDBTypes.h): BATCH yields to all other
+# traffic under load, IMMEDIATE bypasses ratekeeper admission (system work
+# must proceed while the cluster sheds load)
+PRIORITY_BATCH, PRIORITY_DEFAULT, PRIORITY_IMMEDIATE = 0, 1, 2
+
+
 @dataclasses.dataclass
 class GetReadVersionRequest:
     debug_id: str | None = None
+    priority: int = PRIORITY_DEFAULT
 
 
 @dataclasses.dataclass
