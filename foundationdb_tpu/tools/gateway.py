@@ -50,6 +50,11 @@ from ..runtime.core import EventLoop, Future, TaskPriority, TimedOut
 _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<QB")  # req_id, op
 
+# wire-protocol version, announced via GET_PROTOCOL (op 12): the multi-
+# version client (client/multiversion.py) probes it to select a matching
+# client implementation, the reference's currentProtocolVersion handshake
+PROTOCOL_VERSION = 1
+
 # the single source of truth for ABI status codes: the ABI constants AND
 # the vexillographer's generated table both derive from this dict
 STATUS_CODES = {
@@ -197,7 +202,9 @@ class ClientGateway:
         try:
             out = bytearray()
             status = OK
-            if op == 1:  # NEW_TXN
+            if op == 12:  # GET_PROTOCOL (no txn id)
+                out += struct.pack("<I", PROTOCOL_VERSION)
+            elif op == 1:  # NEW_TXN
                 self._txn_seq += 1
                 conn.txns[self._txn_seq] = self.db.create_ryw_transaction()
                 out += struct.pack("<Q", self._txn_seq)
