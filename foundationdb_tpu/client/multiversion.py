@@ -40,6 +40,8 @@ class MultiVersionDatabase:
         return self._active_version
 
     def _ensure(self):
+        if self._active_version is not None:
+            return self._db  # lazy: re-probe only on mismatch/first use
         v = self._probe()
         if v != self._active_version:
             if v not in self._factories:

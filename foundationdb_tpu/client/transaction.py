@@ -91,6 +91,9 @@ class ClusterView:
         self.commits = as_list(commit_refs)
         self.smap = storage_map
         self.epoch = epoch
+        # special key space handlers (SpecialKeySpace.actor.cpp): module
+        # reads under \xff\xff, e.g. the status-client path
+        self.special_keys: dict[bytes, object] = {}
 
 
 class QueueModel:
@@ -401,6 +404,14 @@ class Transaction:
 
     # -- reads --------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        if key.startswith(b"\xff\xff"):
+            # special key space (fdbclient/SpecialKeySpace.actor.cpp): reads
+            # under \xff\xff are answered by module handlers, not storage —
+            # e.g. \xff\xff/status/json is the status-client fetch path
+            handler = self.db.view.special_keys.get(key)
+            if handler is None:
+                return None
+            return handler()
         v = await self.get_read_version()
         # loadBalance (fdbrpc/LoadBalance.actor.h:159): pick a random replica
         # of the shard's team per attempt; _reply_rerouted re-picks on a

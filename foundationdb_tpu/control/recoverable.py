@@ -59,6 +59,8 @@ class RecoverableCluster:
                                 # worker.actor.cpp bootstrap) and a
                                 # fdbmonitor analog restarts dead workers;
                                 # 0 = roles constructed directly
+        trace_sink=None,        # file-like: trace events stream to it as
+                                # JSONL (the reference's rolling trace files)
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -74,7 +76,7 @@ class RecoverableCluster:
             _buggify.disable()
             self.knobs = knobs or CoreKnobs()
             self.client_knobs = ClientKnobs()
-        self.trace = TraceCollector(clock=self.loop.now)
+        self.trace = TraceCollector(clock=self.loop.now, sink=trace_sink)
         from ..runtime.trace import g_trace_batch
 
         g_trace_batch.attach_clock(self.loop.now)
@@ -317,6 +319,17 @@ class RecoverableCluster:
     def database(self) -> Database:
         proc = self.net.create_process(f"client-{self.rng.random_unique_id()[:6]}")
         view = self.controller.make_view(proc)
+
+        def _status_json() -> bytes:
+            import json
+
+            from .status import cluster_status
+
+            return json.dumps(cluster_status(self), default=str).encode()
+
+        # special key space handlers (SpecialKeySpace.actor.cpp): the
+        # status-client path reads \xff\xff/status/json like any key
+        view.special_keys = {b"\xff\xff/status/json": _status_json}
         return Database(self.loop, view, self.rng,
                         client_knobs=self.client_knobs)
 

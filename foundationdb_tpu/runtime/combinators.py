@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Awaitable, Callable, Iterable, Sequence
 
-from .core import EventLoop, Future, Promise, TaskPriority, TimedOut
+from .core import ActorCancelled, EventLoop, Future, Promise, TaskPriority, TimedOut
 
 
 def wait_all(futures: Sequence[Future]) -> Future:
@@ -158,3 +158,23 @@ async def recurring(loop: EventLoop, fn: Callable[[], Any], interval: float,
     while True:
         await loop.delay(interval, priority)
         fn()
+
+
+async def broadcast(loop: EventLoop, refs: Sequence, payload: Any,
+                    timeout: float = 1.0) -> list:
+    """Fire the same request at every ref, gather replies best-effort
+    (genericactors broadcast): unreachable peers yield None instead of
+    failing the whole fan-out — the pattern behind pings, confirms, and
+    registration sweeps."""
+
+    async def one(ref):
+        try:
+            return await ref.get_reply(payload, timeout=timeout)
+        except ActorCancelled:
+            raise  # cancellation is not an unreachable peer
+        except Exception:  # noqa: BLE001 — best-effort by contract
+            return None
+
+    return await wait_all(
+        [loop.spawn(one(r), TaskPriority.DEFAULT_ENDPOINT) for r in refs]
+    )

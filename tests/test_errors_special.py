@@ -1,0 +1,93 @@
+"""Error registry numbering, broadcast combinator, special-key status
+client, and trace-file streaming (flow/Error.h error codes;
+genericactors broadcast; SpecialKeySpace \\xff\\xff/status/json;
+the reference's rolling trace files)."""
+
+import io
+import json
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.roles.errors import error_code, error_for_code, error_name
+from foundationdb_tpu.roles.types import (
+    CommitUnknownResult,
+    NotCommitted,
+    TransactionTooOld,
+)
+from foundationdb_tpu.runtime.core import BrokenPromise, TimedOut
+
+
+def test_error_codes_match_reference_numbering():
+    assert error_code(NotCommitted()) == 1020
+    assert error_code(CommitUnknownResult()) == 1021
+    assert error_code(TransactionTooOld()) == 1007
+    assert error_code(TimedOut("x")) == 1004
+    assert error_code(BrokenPromise("x")) == 1100
+    assert error_code(ValueError("internal")) == 4100
+    assert error_name(1020) == "not_committed"
+    # wire roundtrip: code -> typed exception -> same code
+    for code in (1004, 1007, 1009, 1020, 1021, 1100, 1101):
+        assert error_code(error_for_code(code)) == code
+
+
+def test_broadcast_best_effort():
+    from foundationdb_tpu.roles.types import TLogConfirmRequest
+    from foundationdb_tpu.runtime.combinators import broadcast
+
+    c = RecoverableCluster(seed=1601, n_storage_shards=1, storage_replication=2)
+    gen = c.controller.generation
+    cc = c.controller._cc_proc()
+    from foundationdb_tpu.rpc.stream import RequestStreamRef
+
+    refs = [
+        RequestStreamRef(c.net, cc, t.confirm_stream.endpoint)
+        for t in gen.tlogs
+    ]
+    # kill one TLog: its slot yields None, the other still answers
+    gen.tlogs[0].process.kill()
+
+    async def main():
+        return await broadcast(c.loop, refs, TLogConfirmRequest(), timeout=0.5)
+
+    replies = c.run_until(c.loop.spawn(main()), 300)
+    assert len(replies) == 2
+    assert sum(r is not None for r in replies) >= 1
+    assert any(r is None for r in replies)
+    c.stop()
+
+
+def test_status_json_special_key():
+    from foundationdb_tpu.control.status import validate_status
+
+    c = RecoverableCluster(seed=1602, n_storage_shards=2, storage_replication=2)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        raw = await tr.get(b"\xff\xff/status/json")
+        missing = await tr.get(b"\xff\xff/no/such/module")
+        return raw, missing
+
+    raw, missing = c.run_until(c.loop.spawn(main()), 300)
+    assert missing is None
+    doc = json.loads(raw)
+    validate_status(doc)  # the client-fetched doc obeys the schema
+    assert doc["cluster"]["generation"]["state"] == "fully_recovered"
+    c.stop()
+
+
+def test_trace_sink_streams_jsonl():
+    sink = io.StringIO()
+    c = RecoverableCluster(seed=1603, n_storage_shards=1,
+                           storage_replication=2, trace_sink=sink)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"t", b"1")
+        await tr.commit()
+
+    c.run_until(c.loop.spawn(main()), 300)
+    c.stop()
+    lines = [json.loads(l) for l in sink.getvalue().splitlines() if l.strip()]
+    assert any(e["Type"] == "MasterRecoveryState" for e in lines)
+    assert all("Time" in e and "Severity" in e for e in lines)
