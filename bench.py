@@ -304,6 +304,9 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
         ("bucket", "scatter", False),
         ("bucket", "sort", False),
         ("bucket", "sort", True),
+        # LSM's per-batch merge runs at rec_cap scale, where the scatter
+        # twin may beat the sort twin — measure, don't assume
+        ("bucket", "scatter", True),
     ]
     results = {}
     for si, mi, lsm in combos:

@@ -27,6 +27,11 @@ commands:
   getrange <begin> <end> [n]  scan up to n keys (default 25)
   watch <key>                 block until the key changes
   status [json]               cluster status summary (or full json)
+  configure k=v ...           change role counts (n_tlogs/n_proxies/n_resolvers)
+  move <begin> <end> <shard>  MoveKeys: migrate a range to shard's team
+  backup start <prefix>       continuous backup + snapshot into the cluster fs
+  backup status | stop        backup progress / stop
+  errorcode <n>               name a numeric error code
   kill <process-name>         kill a process by name (recovery chaos)
   processes                   list processes
   help                        this text
@@ -112,6 +117,41 @@ class Cli:
                     f"storage {s['tag']}: {s['keys']} keys, v{s['version']}"
                 )
             return "\n".join(lines)
+        if cmd == "configure":
+            # configure n_tlogs=3 n_proxies=2 ... (ManagementAPI changeConfig)
+            from ..client.management import configure
+
+            kw = dict(p.split("=") for p in args)
+            async def go():
+                await configure(self.db, **{k: int(v) for k, v in kw.items()})
+            self._run(go())
+            return f"configured {kw} (takes effect at next conf poll)"
+        if cmd == "move":
+            # move BEGIN END SHARD_IDX — MoveKeys through data distribution
+            dest = c.controller.storage_teams_tags[int(args[2])]
+            ok = self._run(c.dd.move_range(_b(args[0]), _b(args[1]), list(dest)))
+            return "moved" if ok else "move refused (range/team invalid or busy)"
+        if cmd == "backup":
+            # backup start PREFIX | backup status | backup stop
+            from ..client.backup import BackupAgent, BackupContainer
+
+            if args[0] == "start":
+                self._agent = BackupAgent(c)
+                self._container = BackupContainer(c.fs, args[1])
+                vm = self._run(self._agent.start(self._container))
+                snap_v = self._run(self._agent.snapshot(self._container))
+                return f"backup running from v{vm}, snapshot @v{snap_v}"
+            if args[0] == "status":
+                if getattr(self, "_agent", None) is None or self._agent.worker is None:
+                    return "no backup running"
+                return f"backed up to v{self._agent.worker.backed_up.get()}"
+            if args[0] == "stop":
+                self._run(self._agent.stop())
+                return "backup stopped"
+        if cmd == "errorcode":
+            from ..roles.errors import error_name
+
+            return error_name(int(args[0]))
         if cmd == "processes":
             return "\n".join(
                 f"{p.name:28s} {addr} {'up' if p.alive else 'DOWN'}"

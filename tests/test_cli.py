@@ -48,3 +48,24 @@ def test_vexillographer_doc_in_sync():
     assert committed == generate(), (
         "KNOBS.md is stale: run python -m foundationdb_tpu.tools.vexillographer"
     )
+
+
+def test_cli_move_backup_configure_errorcode():
+    from foundationdb_tpu.tools.cli import Cli
+
+    cli = Cli(seed=1701, n_storage_shards=2, storage_replication=2)
+    for i in range(30):
+        cli.one_command(f"set mk{i:03d} v{i}")
+    out = cli.one_command("move mk010 mk020 1")
+    assert out == "moved"
+    assert cli.one_command("get mk015") == repr(b"v15")
+
+    out = cli.one_command("backup start bk-cli")
+    assert out.startswith("backup running")
+    assert cli.one_command("backup status").startswith("backed up to v")
+    assert cli.one_command("backup stop") == "backup stopped"
+
+    out = cli.one_command("configure n_tlogs=3")
+    assert "n_tlogs" in out
+    assert cli.one_command("errorcode 1020") == "not_committed"
+    cli.cluster.stop()
