@@ -61,6 +61,10 @@ class DataDistributor:
         self.heals = 0
         self.shard_splits = 0
         self._moving = False
+        self._seg_prev: tuple = (None, 0.0)  # write-rate differencing state
+        self._metrics_tick = 0
+        self._sizes: list | None = None  # cached shard size metrics
+        self._counts: list | None = None
         self._heal_seq = 0
         self._pong_tasks: dict[str, object] = {}
         for ss in controller.storage:
@@ -197,24 +201,78 @@ class DataDistributor:
         )
 
     # -- shard splitting -----------------------------------------------------
+    def _write_rates(self, gen, n_segs: int) -> list[float]:
+        """Per-segment committed write bandwidth (bytes/s) from the proxies'
+        StorageMetrics counters, differenced against the last poll."""
+        totals = [0] * n_segs
+        for p in gen.proxies:
+            segw = p.seg_write_bytes
+            if len(segw) != n_segs:
+                continue  # map swap mid-poll; next tick realigns
+            for i, v in enumerate(segw):
+                totals[i] += v
+        now = self.loop.now()
+        prev, prev_t = self._seg_prev
+        self._seg_prev = (totals, now)
+        if prev is None or len(prev) != n_segs or now <= prev_t:
+            return [0.0] * n_segs
+        dt = now - prev_t
+        return [max(t - pv, 0) / dt for t, pv in zip(totals, prev)]
+
     async def _split_loop(self) -> None:
         cc = self.cc
         while True:
             await self.loop.delay(self.knobs.DD_SPLIT_INTERVAL, TaskPriority.COORDINATION)
-            if cc.generation is None or cc._recovering or self._moving:
+            gen = cc.generation
+            if gen is None or cc._recovering or self._moving:
                 continue
             teams = cc.storage_teams_tags
             if len(teams) < 2:
                 continue
             bounds = [b""] + list(cc.storage_splits) + [None]
-            sizes = []
-            for i, team in enumerate(teams):
-                b, e = bounds[i], bounds[i + 1]
-                ss = cc._tag_to_ss[team[0]]
-                sizes.append(ss.store.count_range(b, e if e is not None else TOP_KEY))
-            hot = max(range(len(sizes)), key=lambda i: sizes[i])
-            if sizes[hot] <= self.knobs.DD_SHARD_SPLIT_KEYS:
+            # size metrics walk resident data: refresh them every few ticks,
+            # not every poll (the reference samples, it never rescans)
+            self._metrics_tick += 1
+            if self._sizes is None or len(self._sizes) != len(teams) \
+                    or self._metrics_tick % 4 == 0:
+                sizes, counts = [], []
+                for i, team in enumerate(teams):
+                    b, e = bounds[i], bounds[i + 1]
+                    ss = cc._tag_to_ss[team[0]]
+                    n, bts = ss.shard_metrics(b, e if e is not None else TOP_KEY)
+                    counts.append(n)
+                    sizes.append(bts)
+                self._sizes, self._counts = sizes, counts
+            sizes, counts = self._sizes, self._counts
+            wrates = self._write_rates(gen, len(teams))
+
+            # split candidates in priority order: write-HOT, then byte size,
+            # then key count (the halves of the reference's shardSplitter
+            # decision); a candidate without a usable split key falls
+            # through instead of starving the others
+            candidates = []
+            hot_w = max(range(len(teams)), key=lambda i: wrates[i])
+            if wrates[hot_w] > self.knobs.DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC:
+                candidates.append((hot_w, "write_hot"))
+            hot_b = max(range(len(teams)), key=lambda i: sizes[i])
+            if sizes[hot_b] > self.knobs.DD_SHARD_SPLIT_BYTES:
+                candidates.append((hot_b, "bytes"))
+            hot_c = max(range(len(teams)), key=lambda i: counts[i])
+            if counts[hot_c] > self.knobs.DD_SHARD_SPLIT_KEYS:
+                candidates.append((hot_c, "keys"))
+
+            hot = key = reason = None
+            for idx, why in candidates:
+                ss = cc._tag_to_ss[teams[idx][0]]
+                b, e = bounds[idx], bounds[idx + 1]
+                k = ss.split_point(b, e if e is not None else TOP_KEY)
+                if k is not None:
+                    hot, key, reason = idx, k, why
+                    break
+            if hot is None:
                 continue
+            if reason == "write_hot":
+                testcov("dd.split_write_hot")
             cold = min(
                 (i for i in range(len(sizes)) if set(teams[i]) != set(teams[hot])),
                 key=lambda i: sizes[i],
@@ -222,11 +280,7 @@ class DataDistributor:
             )
             if cold is None:
                 continue
-            b, e = bounds[hot], bounds[hot + 1]
-            ss = cc._tag_to_ss[teams[hot][0]]
-            key = ss.store.middle_key(b, e if e is not None else TOP_KEY)
-            if key is None:
-                continue
+            e = bounds[hot + 1]
             moved = await self.move_range(key, e, list(teams[cold]))
             if moved:
                 self.shard_splits += 1

@@ -124,6 +124,10 @@ class CommitProxy:
         self.tag_to_tlogs = tag_to_tlogs or {
             t: [0] for team in storage_tags.members for t in team
         }
+        # per-SEGMENT committed write bytes (StorageMetrics' bandwidth half:
+        # data distribution reads these to find write-hot shards); reset
+        # whenever the keyServers map is swapped, since indexes re-segment
+        self.seg_write_bytes = [0] * len(storage_tags.members)
         self.backup_tag: str | None = None  # set while a backup is running
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
@@ -199,6 +203,7 @@ class CommitProxy:
         through the pipeline itself, MoveKeys.actor.cpp:875)."""
         self.tags = pmap
         self.tag_to_tlogs = dict(tag_to_tlogs)
+        self.seg_write_bytes = [0] * len(pmap.members)
 
     @property
     def inflight_batches(self) -> int:
@@ -375,10 +380,17 @@ class CommitProxy:
             if v != Verdict.COMMITTED:
                 continue
             for m in pc.request.mutations:
+                nb = len(m.key) + len(m.value or b"")
                 if m.type == MutationType.CLEAR_RANGE:
                     teams = self.tags.members_for_range(m.key, m.value)
+                    lo = bisect.bisect_right(self.tags.splits, m.key)
+                    for s in range(lo, lo + len(teams)):
+                        self.seg_write_bytes[s] += nb
                 else:
                     teams = [self.tags.member_for_key(m.key)]
+                    self.seg_write_bytes[
+                        bisect.bisect_right(self.tags.splits, m.key)
+                    ] += nb
                 # a member is a storage TEAM: every replica has its own tag
                 # and receives every mutation of its shard (the reference
                 # tags each mutation with the whole team's server tags)

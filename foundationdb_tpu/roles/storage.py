@@ -79,6 +79,15 @@ class MemoryKeyValueStore:
         hi = bisect.bisect_left(self._keys, end)
         return hi - lo
 
+    def bytes_range(self, begin: bytes, end: bytes) -> int:
+        """Stored bytes in [begin, end) — the StorageMetrics size half (the
+        reference splits shards on BYTES, not key counts)."""
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        return sum(
+            len(k) + len(self._data[k]) for k in self._keys[lo:hi]
+        )
+
     def middle_key(self, begin: bytes, end: bytes) -> bytes | None:
         """Median key of [begin, end) — the data-distribution split-point
         sample (the reference samples byte-weighted splits via
@@ -473,6 +482,45 @@ class StorageServer:
             self._fire_watches(
                 [Mutation(MutationType.CLEAR_RANGE, fs.begin, fs.end_key)]
             )
+
+    def shard_metrics(self, begin: bytes, end: bytes) -> tuple[int, int]:
+        """Approximate (keys, bytes) in [begin, end) over the LIVE view —
+        base store plus the un-flushed MVCC-window overlay (StorageMetrics
+        measures what is there, not what has been flushed).  Overlay keys
+        are deduplicated against the base and tombstones subtract, so a
+        rewrite-heavy window does not inflate the metric."""
+        n = self.store.count_range(begin, end)
+        bts = self.store.bytes_range(begin, end)
+        for k in self.overlay.overlay_keys_in(begin, end):
+            chain = self.overlay._chains.get(k)
+            newest = chain[-1][1] if chain else None
+            in_base = self.store.get(k) is not None
+            if newest is _CLEARED:
+                if in_base:
+                    n -= 1
+                    bts -= len(k)  # value size unknown without a read
+            elif not in_base:
+                n += 1
+                bts += len(k) + (
+                    len(newest) if isinstance(newest, (bytes, bytearray)) else 0
+                )
+        return max(n, 0), max(bts, 0)
+
+    def split_point(self, begin: bytes, end: bytes) -> bytes | None:
+        """Median live key of [begin, end) — data distribution's split-key
+        sample.  The committed median (O(log n) via the store) serves; only
+        a near-empty base falls back to the window overlay, which is small
+        by construction."""
+        k = self.store.middle_key(begin, end)
+        if k is not None:
+            return k
+        keys = sorted(
+            set(k for k, _v in self.store.range_read(begin, end, 1000))
+            | set(self.overlay.overlay_keys_in(begin, end))
+        )
+        if len(keys) < 2:
+            return None
+        return keys[len(keys) // 2]
 
     def drop_range(self, begin: bytes, end: bytes | None) -> None:
         """Discard [begin, end) (the source side after a completed move)."""

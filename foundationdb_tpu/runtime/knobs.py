@@ -110,6 +110,11 @@ class CoreKnobs(Knobs):
         self.init("DD_PING_INTERVAL", 0.25)
         self.init("DD_SPLIT_INTERVAL", 0.5)
         self.init("DD_SHARD_SPLIT_KEYS", 100_000)
+        # StorageMetrics-style split thresholds: shard byte size and
+        # committed write bandwidth (reference SHARD_MAX_BYTES +
+        # shardSplitter's bandwidth half)
+        self.init("DD_SHARD_SPLIT_BYTES", 10_000_000)
+        self.init("DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC", 1_000_000)
 
     @property
     def mvcc_window_versions(self) -> int:

@@ -66,6 +66,7 @@ class BTreeKeyValueStore:
         self._dir_keys: list[bytes] = []
         self._dir_offs: list[int] = []
         self._dir_cnts: list[int] = []
+        self._dir_bytes: list[int] = []
         # memtable: uncommitted point writes (None = delete) + clear ranges
         self._mem: dict[bytes, bytes | None] = {}
         self._clears: list[tuple[bytes, bytes]] = []
@@ -146,24 +147,49 @@ class BTreeKeyValueStore:
             1 for v in self._mem.values() if v is not None
         )
 
-    def _committed_count(self, begin: bytes, end: bytes) -> int:
-        """Committed keys in [begin, end): O(log n) via the directory's
-        per-leaf counts, decoding only the two edge leaves."""
+    def _walk_dir(self, begin: bytes, end: bytes):
+        """Yield (leaf_index, fully_inside, lo, hi) for every directory leaf
+        overlapping [begin, end); lo/hi are entry bounds for edge leaves
+        (None for fully-covered ones) — the one walk behind every
+        directory-served metric."""
         dk = self._dir_keys
         if not dk or begin >= end:
-            return 0
-        total = 0
+            return
         i = max(bisect.bisect_right(dk, begin) - 1, 0)
         while i < len(dk):
             if dk[i] >= end:
                 break
             fully = dk[i] >= begin and (i + 1 < len(dk) and dk[i + 1] <= end)
             if fully:
-                total += self._dir_cnts[i]
+                yield i, True, None, None
             else:
                 keys, _vals = self._read_leaf(self._dir_offs[i])
-                total += bisect.bisect_left(keys, end) - bisect.bisect_left(keys, begin)
+                yield (
+                    i, False,
+                    bisect.bisect_left(keys, begin),
+                    bisect.bisect_left(keys, end),
+                )
             i += 1
+
+    def _committed_count(self, begin: bytes, end: bytes) -> int:
+        """Committed keys in [begin, end): O(log n) via the directory's
+        per-leaf counts, decoding only the two edge leaves."""
+        total = 0
+        for i, fully, lo, hi in self._walk_dir(begin, end):
+            total += self._dir_cnts[i] if fully else hi - lo
+        return total
+
+    def bytes_range(self, begin: bytes, end: bytes) -> int:
+        """Committed bytes in [begin, end): full leaves served from the
+        directory's byte sums, edge leaves decoded (memtable/clears excluded
+        — a sampling-grade answer, like the reference's StorageMetrics)."""
+        total = 0
+        for i, fully, lo, hi in self._walk_dir(begin, end):
+            if fully:
+                total += self._dir_bytes[i]
+            else:
+                keys, vals = self._read_leaf(self._dir_offs[i])
+                total += sum(len(keys[j]) + len(vals[j]) for j in range(lo, hi))
         return total
 
     def count_range(self, begin: bytes, end: bytes) -> int:
@@ -211,24 +237,18 @@ class BTreeKeyValueStore:
         if total < 2:
             return None
         target = total // 2
-        dk = self._dir_keys
-        i = max(bisect.bisect_right(dk, begin) - 1, 0)
-        while i < len(dk) and dk[i] < end:
-            fully = dk[i] >= begin and (i + 1 < len(dk) and dk[i + 1] <= end)
+        for i, fully, lo, hi in self._walk_dir(begin, end):
             if fully:
                 n = self._dir_cnts[i]
                 if target < n:
                     keys, _vals = self._read_leaf(self._dir_offs[i])
                     return keys[target]
             else:
-                keys, _vals = self._read_leaf(self._dir_offs[i])
-                lo = bisect.bisect_left(keys, begin)
-                hi = bisect.bisect_left(keys, end)
                 n = hi - lo
                 if target < n:
+                    keys, _vals = self._read_leaf(self._dir_offs[i])
                     return keys[lo + target]
             target -= n
-            i += 1
         return None
 
     # ---- commit -------------------------------------------------------------
@@ -261,7 +281,8 @@ class BTreeKeyValueStore:
         """Serialize the leaf directory as branch pages, return the root
         offset (-1 = empty tree).  Branch levels are 1/fanout of the leaves,
         so rebuilding them per commit is cheap and keeps recovery O(dir)."""
-        entries = list(zip(self._dir_keys, self._dir_offs, self._dir_cnts))
+        entries = list(zip(self._dir_keys, self._dir_offs, self._dir_cnts,
+                           self._dir_bytes))
         if not entries:
             return -1
         while True:
@@ -270,10 +291,14 @@ class BTreeKeyValueStore:
                 chunk = entries[i : i + _FANOUT]
                 off = self._append_page(
                     _BRANCH,
-                    [k for k, _o, _c in chunk],
-                    [(o, c) for _k, o, c in chunk],
+                    [k for k, _o, _c, _b in chunk],
+                    [(o, c, b) for _k, o, c, b in chunk],
                 )
-                pages.append((chunk[0][0], off, sum(c for _k, _o, c in chunk)))
+                pages.append((
+                    chunk[0][0], off,
+                    sum(c for _k, _o, c, _b in chunk),
+                    sum(b for _k, _o, _c, b in chunk),
+                ))
             if len(pages) == 1:
                 return pages[0][1]
             entries = pages
@@ -305,8 +330,11 @@ class BTreeKeyValueStore:
                 self._dir_keys, self._dir_offs, self._dir_cnts = (
                     [keys[0]], [off], [len(keys)]
                 )
+                self._dir_bytes = [
+                    sum(len(k) + len(v) for k, v in zip(keys, vals))
+                ]
             return
-        for k, (child, cnt) in zip(keys, vals):
+        for k, (child, cnt, nbytes) in zip(keys, vals):
             ckind, _ckeys, _cvals = self._read_page(child)
             if ckind == _BRANCH:
                 self._load_dir(child)
@@ -314,6 +342,7 @@ class BTreeKeyValueStore:
                 self._dir_keys.append(k)
                 self._dir_offs.append(child)
                 self._dir_cnts.append(cnt)
+                self._dir_bytes.append(nbytes)
 
     # ---- page IO ------------------------------------------------------------
     def _append_page(self, kind: int, keys: list, vals: list) -> int:
@@ -323,7 +352,7 @@ class BTreeKeyValueStore:
             if kind == _LEAF:
                 w.bytes_(vals[i])
             else:
-                w.i64(vals[i][0]).i64(vals[i][1])
+                w.i64(vals[i][0]).i64(vals[i][1]).i64(vals[i][2])
         body = w.data()
         page = (
             BinaryWriter().u32(len(body)).u32(zlib.crc32(body) & 0xFFFFFFFF).data()
@@ -354,7 +383,9 @@ class BTreeKeyValueStore:
         keys, vals = [], []
         for _ in range(n):
             keys.append(r.bytes_())
-            vals.append(r.bytes_() if kind == _LEAF else (r.i64(), r.i64()))
+            vals.append(
+                r.bytes_() if kind == _LEAF else (r.i64(), r.i64(), r.i64())
+            )
         page = (kind, keys, vals)
         self._cache_put(key, page)
         return page
@@ -435,7 +466,7 @@ class BTreeKeyValueStore:
     def _replace_leaves(self, lo_idx: int, hi_idx: int, rows) -> int:
         """Replace directory entries [lo_idx, hi_idx) with fresh leaves for
         `rows`; returns how many entries were inserted."""
-        new_k, new_o, new_c = [], [], []
+        new_k, new_o, new_c, new_b = [], [], [], []
         for s in range(0, len(rows), _FANOUT):
             chunk = rows[s : s + _FANOUT]
             off = self._append_page(
@@ -444,9 +475,11 @@ class BTreeKeyValueStore:
             new_k.append(chunk[0][0])
             new_o.append(off)
             new_c.append(len(chunk))
+            new_b.append(sum(len(k) + len(v) for k, v in chunk))
         self._dir_keys[lo_idx:hi_idx] = new_k
         self._dir_offs[lo_idx:hi_idx] = new_o
         self._dir_cnts[lo_idx:hi_idx] = new_c
+        self._dir_bytes[lo_idx:hi_idx] = new_b
         return len(new_k)
 
     # ---- compaction ---------------------------------------------------------
@@ -462,6 +495,7 @@ class BTreeKeyValueStore:
         self._appended = 0
         self._cache.clear()
         self._dir_keys, self._dir_offs, self._dir_cnts = [], [], []
+        self._dir_bytes = []
         self._replace_leaves(0, 0, rows)
         self._live_bytes = max(sum(len(k) + len(v) for k, v in rows), 1)
         root = self._write_branches()

@@ -244,3 +244,64 @@ def test_heal_durable_cluster_restart():
     rows_p = _get_all(c2, db2, b"p", b"q")
     assert len(rows_p) == 40
     c2.stop()
+
+
+def test_split_on_bytes_threshold():
+    """Big values trip the BYTE threshold long before the key count does
+    (the reference splits on bytes via StorageMetrics)."""
+    c = RecoverableCluster(seed=208, n_storage_shards=2, storage_replication=2,
+                           durable=False)
+    c.knobs.DD_SHARD_SPLIT_BYTES = 20_000
+    db = c.database()
+
+    async def put():
+        for base in range(0, 60, 20):
+            tr = db.create_transaction()
+            for i in range(base, base + 20):
+                tr.set(b"big%04d" % i, b"x" * 900)
+            await tr.commit()
+
+    c.run_until(c.loop.spawn(put()), 600)
+
+    async def wait_split():
+        for _ in range(200):
+            if c.dd.shard_splits >= 1:
+                return True
+            await c.loop.delay(0.2)
+        return False
+
+    assert c.run_until(c.loop.spawn(wait_split()), 600)
+    rows = _get_all(c, db, b"big", b"bih")
+    assert len(rows) == 60
+    c.stop()
+
+
+def test_split_on_write_bandwidth():
+    """A small-but-write-hot shard splits on bandwidth alone (the other
+    half of shardSplitter's decision)."""
+    c = RecoverableCluster(seed=209, n_storage_shards=2, storage_replication=2,
+                           durable=False)
+    c.knobs.DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC = 2_000
+    c.knobs.DD_SHARD_SPLIT_BYTES = 1 << 40       # never by size
+    c.knobs.DD_SHARD_SPLIT_KEYS = 1 << 40        # never by count
+    db = c.database()
+
+    async def hammer():
+        # sustained overwrites of a handful of keys: tiny shard, hot writes
+        for round_ in range(60):
+            tr = db.create_transaction()
+            for i in range(4):
+                tr.set(b"hot%02d" % i, b"w" * 200)
+            await tr.commit()
+            await c.loop.delay(0.05)
+        # the move's flip waits for destination durability (~1 MVCC window)
+        for _ in range(200):
+            if c.dd.shard_splits >= 1:
+                return True
+            await c.loop.delay(0.2)
+        return False
+
+    assert c.run_until(c.loop.spawn(hammer()), 600)
+    rows = _get_all(c, db, b"hot", b"hou")
+    assert len(rows) == 4
+    c.stop()
