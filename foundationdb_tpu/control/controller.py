@@ -166,7 +166,8 @@ class ClusterController:
         self.resolver_moves = 0
         self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
-        self.backup_worker = None  # BackupWorker while a backup is running
+        # full-stream consumers: tag -> worker (backup, log routers)
+        self.stream_consumers: dict[str, object] = {}
         self.views: list[ClusterView] = []
         self.recovery_state = RecoveryState.READING_CSTATE
         self._recovering = False
@@ -360,12 +361,13 @@ class ClusterController:
     ) -> list[dict]:
         """Rebuild per-new-tlog tag seeds from surviving replicas."""
         from ..roles.backup import BACKUP_TAG
+        from ..roles.logrouter import ROUTER_TAG
 
         merged: dict[str, list] = {}
         for r in alive:
             for tag, entries in r.tags.items():
-                if tag == BACKUP_TAG and self.backup_worker is None:
-                    continue  # residue of a finished backup: drop, not seed
+                if tag in (BACKUP_TAG, ROUTER_TAG) and tag not in self.stream_consumers:
+                    continue  # residue of a finished consumer: drop, not seed
                 cur = merged.setdefault(tag, [])
                 have = {v for v, _ in cur}
                 cur.extend((v, m) for v, m in entries if v not in have)
@@ -475,16 +477,25 @@ class ClusterController:
         self._tag_to_ss[new.tag] = new
         self.storage[self.storage.index(old)] = new
 
-    # -- backup (FileBackupAgent enable/disable + worker wiring) -------------
-    async def enable_backup(self, worker) -> Version | None:
-        """Tag every future commit with the backup tag and wire the worker
-        to this generation's TLogs.  Returns the boundary version: the
-        mutation log is complete from it onward.  None = recovery raced or
-        the commit plane would not drain (caller retries)."""
+    # -- full-stream consumers (backup workers + log routers) ----------------
+    # A full-stream consumer owns a dedicated tag that every committed
+    # mutation is ALSO tagged with; it survives generations by rejoining
+    # its tag like storage does (the reference's txsTag/backup tags and the
+    # log-router tags of multi-region replication share this shape).
+
+    @property
+    def backup_worker(self):
         from ..roles.backup import BACKUP_TAG
 
-        if self.backup_worker is not None:
-            raise RuntimeError("a backup is already running (one backup tag)")
+        return self.stream_consumers.get(BACKUP_TAG)
+
+    async def enable_stream_consumer(self, tag: str, worker) -> Version | None:
+        """Tag every future commit with `tag` and wire the consumer to this
+        generation's TLogs.  Returns the boundary version: the stream is
+        complete from it onward.  None = recovery raced or the commit plane
+        would not drain (caller retries)."""
+        if tag in self.stream_consumers:
+            raise RuntimeError(f"stream tag {tag!r} already has a consumer")
         gen = self.generation
         if gen is None or self._recovering:
             return None
@@ -498,21 +509,19 @@ class ClusterController:
             if gen is not self.generation or self._recovering:
                 return None
             for p in gen.proxies:
-                p.tag_to_tlogs = {**p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)}
-                p.backup_tag = BACKUP_TAG
-            self.backup_worker = worker
-            self._wire_backup(gen)
+                p.tag_to_tlogs = {**p.tag_to_tlogs, tag: self._tag_tlogs(tag)}
+                p.full_stream_tags = p.full_stream_tags + [tag]
+            self.stream_consumers[tag] = worker
+            self._wire_stream_consumer(gen, tag)
             return gen.sequencer._last_assigned
         finally:
             for p in gen.proxies:
                 p.resume_commits()
 
-    async def disable_backup(self) -> None:
-        from ..roles.backup import BACKUP_TAG
-
+    async def disable_stream_consumer(self, tag: str) -> None:
         # cleared FIRST: a recovery racing anything below recruits its new
-        # generation without the backup tag
-        self.backup_worker = None
+        # generation without the tag
+        self.stream_consumers.pop(tag, None)
         gen = self.generation
         if gen is None:
             return
@@ -525,10 +534,10 @@ class ClusterController:
                 pass  # clearing the tag un-drained only strands a few
                       # residual entries — the pops below reclaim them
             gen = self.generation  # a recovery may have swapped it (the new
-            if gen is None:        # generation is already backup-free)
+            if gen is None:        # generation is already tag-free)
                 return
             for p in gen.proxies:
-                p.backup_tag = None
+                p.full_stream_tags = [t for t in p.full_stream_tags if t != tag]
         finally:
             for p in (gen.proxies if gen else []):
                 p.resume_commits()
@@ -538,14 +547,12 @@ class ClusterController:
         cc = self._cc_proc()
         for t in gen.tlogs:
             RequestStreamRef(self.net, cc, t.pop_stream.endpoint).send(
-                TLogPopRequest(BACKUP_TAG, upto)
+                TLogPopRequest(tag, upto)
             )
 
-    def _wire_backup(self, gen: GenerationRoles) -> None:
-        from ..roles.backup import BACKUP_TAG
-
-        w = self.backup_worker
-        slots = self._tag_tlogs(BACKUP_TAG)
+    def _wire_stream_consumer(self, gen: GenerationRoles, tag: str) -> None:
+        w = self.stream_consumers[tag]
+        slots = self._tag_tlogs(tag)
         tlog = gen.tlogs[slots[0]]
         w.set_tlog_source(
             RequestStreamRef(self.net, w.process, tlog.peek_stream.endpoint),
@@ -554,6 +561,17 @@ class ClusterController:
                 for s in slots
             ],
         )
+
+    # backward-compatible backup entry points (client/backup.py)
+    async def enable_backup(self, worker) -> Version | None:
+        from ..roles.backup import BACKUP_TAG
+
+        return await self.enable_stream_consumer(BACKUP_TAG, worker)
+
+    async def disable_backup(self) -> None:
+        from ..roles.backup import BACKUP_TAG
+
+        await self.disable_stream_consumer(BACKUP_TAG)
 
     # -- keyServers persistence (data distribution across restarts) ---------
     def _keyservers_dq(self):
@@ -799,14 +817,10 @@ class ClusterController:
         for p in proxies:
             p.ratekeeper = self.ratekeeper
             p.on_commit_failure = self._on_proxy_failure
-        if self.backup_worker is not None:
-            from ..roles.backup import BACKUP_TAG
-
+        for tag in self.stream_consumers:
             for p in proxies:
-                p.tag_to_tlogs = {
-                    **p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)
-                }
-                p.backup_tag = BACKUP_TAG
+                p.tag_to_tlogs = {**p.tag_to_tlogs, tag: self._tag_tlogs(tag)}
+                p.full_stream_tags = p.full_stream_tags + [tag]
         for p in proxies:
             p.peers = [
                 RequestStreamRef(
@@ -926,16 +940,12 @@ class ClusterController:
             proxy.ratekeeper = self.ratekeeper
             proxy.on_commit_failure = self._on_proxy_failure
             proxies.append(proxy)
-        if self.backup_worker is not None:
-            # an active backup survives generations: the new proxies keep
-            # tagging the full stream (the worker rejoins by tag in _rewire)
-            from ..roles.backup import BACKUP_TAG
-
+        # active full-stream consumers survive generations: the new proxies
+        # keep tagging the stream (consumers rejoin by tag in _rewire)
+        for tag in self.stream_consumers:
             for p in proxies:
-                p.tag_to_tlogs = {
-                    **p.tag_to_tlogs, BACKUP_TAG: self._tag_tlogs(BACKUP_TAG)
-                }
-                p.backup_tag = BACKUP_TAG
+                p.tag_to_tlogs = {**p.tag_to_tlogs, tag: self._tag_tlogs(tag)}
+                p.full_stream_tags = p.full_stream_tags + [tag]
         # mutual raw-version refs: each proxy's GRV takes the max over all
         # proxies' committed versions (getLiveCommittedVersion :1002)
         for p in proxies:
@@ -963,8 +973,8 @@ class ClusterController:
                 RequestStreamRef(self.net, ss.process, tlog.pop_stream.endpoint),
                 recovery_version=recovery_version,
             )
-        if self.backup_worker is not None:
-            self._wire_backup(gen)
+        for tag in self.stream_consumers:
+            self._wire_stream_consumer(gen, tag)
         for view in self.views:
             self._fill_view(view)
 
@@ -972,6 +982,7 @@ class ClusterController:
         gen = self.generation
         client_proc = view._client_proc
         view.grvs = [
+
             RequestStreamRef(self.net, client_proc, p.grv_stream.endpoint)
             for p in gen.proxies
         ]
@@ -979,20 +990,25 @@ class ClusterController:
             RequestStreamRef(self.net, client_proc, p.commit_stream.endpoint)
             for p in gen.proxies
         ]
-        view.smap = KeyPartitionMap(
-            self.storage_splits,
-            [
+        if getattr(view, "pinned_smap", None) is not None:
+            # a remote-region view reads its OWN replicas; only the write
+            # path (grvs/commits) follows primary recoveries
+            view.smap = view.pinned_smap
+        else:
+            view.smap = KeyPartitionMap(
+                self.storage_splits,
                 [
-                    {
-                        "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
-                        "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
-                        "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
-                    }
-                    for ss in team
-                ]
-                for team in self._storage_teams()
-            ],
-        )
+                    [
+                        {
+                            "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
+                            "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
+                            "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
+                        }
+                        for ss in team
+                    ]
+                    for team in self._storage_teams()
+                ],
+            )
         view.epoch = self.epoch
 
     def make_view(self, client_proc: SimProcess) -> ClusterView:

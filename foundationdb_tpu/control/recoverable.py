@@ -61,6 +61,10 @@ class RecoverableCluster:
                                 # 0 = roles constructed directly
         trace_sink=None,        # file-like: trace events stream to it as
                                 # JSONL (the reference's rolling trace files)
+        remote_region: bool = False,  # a second region: a log router pulls
+                                # the full stream once and re-serves it to
+                                # remote read replicas of every shard
+                                # (LogRouter.actor.cpp + remote tLogs)
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -219,6 +223,11 @@ class RecoverableCluster:
             expect_workers=n_workers > 0,
         )
 
+        self.log_router = None
+        self.remote_storage: list[StorageServer] = []
+        if remote_region:
+            self._make_log_router(n_storage_shards)
+
         # worker pool + fdbmonitor analog (fdbmonitor/fdbmonitor.cpp: the
         # supervisor that restarts dead fdbserver processes; here a dead
         # worker gets a fresh process that re-registers with the CC)
@@ -277,6 +286,8 @@ class RecoverableCluster:
             self.loop, self.net, self.knobs, self.controller,
             store_factory=_heal_store,
         )
+        if remote_region:
+            self._make_remote_storage(n_storage_shards, make_store)
 
     def _spawn_worker(self, idx: int, pclass: str, reg_ep):
         from ..roles.worker import Worker
@@ -306,6 +317,70 @@ class RecoverableCluster:
                     self.workers[i] = self._spawn_worker(
                         i, self._worker_classes[i], reg_ep
                     )
+
+    def _make_log_router(self, n_storage_shards: int) -> None:
+        """Pre-start half of the remote region: the router is REGISTERED as
+        a full-stream consumer before the first recovery, so generation 1
+        (and a restart's disk recovery) carries its tag from the start —
+        the stream is complete over the cluster's whole life."""
+        from ..roles.logrouter import ROUTER_TAG, LogRouter
+        from ..roles.proxy import KeyPartitionMap
+
+        splits = self._initial_storage_splits
+        remote_tags = [[f"remote-{i}-r0"] for i in range(n_storage_shards)]
+        rproc = self.net.create_process("log-router-0")
+        self.log_router = LogRouter(
+            rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
+        )
+        self.controller.stream_consumers[ROUTER_TAG] = self.log_router
+
+    def _make_remote_storage(self, n_storage_shards: int, make_store) -> None:
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        self.remote_storage: list[StorageServer] = []
+        for i in range(n_storage_shards):
+            p = self.net.create_process(f"remote-storage-{i}")
+            store = make_store(f"remote{i}.kv", p)
+            self.remote_storage.append(
+                StorageServer(
+                    p, self.loop, self.knobs,
+                    tlog_peek_ref=_Ref(self.net, p, self.log_router.peek_stream.endpoint),
+                    tlog_pop_ref=_Ref(self.net, p, self.log_router.pop_stream.endpoint),
+                    tag=f"remote-{i}-r0",
+                    store=store,
+                    start_version=(
+                        store.meta.get("durable_version", 0)
+                        if self.fs is not None else 0
+                    ),
+                )
+            )
+
+    def remote_database(self) -> Database:
+        """A client view whose READS route to the remote region's replicas
+        (GRV/commits still go to the primary pipeline — the remote region
+        is a read replica set, not a write quorum)."""
+        from ..roles.proxy import KeyPartitionMap
+
+        proc = self.net.create_process(
+            f"remote-client-{self.rng.random_unique_id()[:6]}"
+        )
+        view = self.controller.make_view(proc)
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        view.pinned_smap = KeyPartitionMap(
+            list(self._initial_storage_splits),
+            [
+                [{
+                    "getvalue": _Ref(self.net, proc, ss.getvalue_stream.endpoint),
+                    "getkeyvalues": _Ref(self.net, proc, ss.getkv_stream.endpoint),
+                    "watch": _Ref(self.net, proc, ss.watch_stream.endpoint),
+                }]
+                for ss in self.remote_storage
+            ],
+        )
+        view.smap = view.pinned_smap
+        return Database(self.loop, view, self.rng,
+                        client_knobs=self.client_knobs)
 
     @property
     def storage_splits(self) -> list[bytes]:
@@ -349,6 +424,10 @@ class RecoverableCluster:
             self._monitor_task.cancel()
         for w in self.workers:
             w.stop()
+        if self.log_router is not None:
+            self.log_router.stop()
+        for s in self.remote_storage:
+            s.stop()
         self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()
@@ -365,6 +444,10 @@ class RecoverableCluster:
             self._monitor_task.cancel()
         for w in self.workers:
             w.stop()
+        if self.log_router is not None:
+            self.log_router.stop()
+        for s in self.remote_storage:
+            s.stop()
         self.dd.stop()
         self.ratekeeper.stop()
         self.controller.stop()

@@ -128,7 +128,9 @@ class CommitProxy:
         # data distribution reads these to find write-hot shards); reset
         # whenever the keyServers map is swapped, since indexes re-segment
         self.seg_write_bytes = [0] * len(storage_tags.members)
-        self.backup_tag: str | None = None  # set while a backup is running
+        # tags receiving the FULL mutation stream (backup workers, log
+        # routers): every committed mutation is also tagged with each
+        self.full_stream_tags: list[str] = []
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
         self.name = process.name
@@ -397,11 +399,11 @@ class CommitProxy:
                 for team in teams:
                     for tag in team:
                         by_tag.setdefault(tag, []).append(m)
-                if self.backup_tag is not None:
-                    # backup workers subscribe to the FULL mutation stream
-                    # via their own tag (the reference's backup workers pull
-                    # txsTag'd backup mutations the same way)
-                    by_tag.setdefault(self.backup_tag, []).append(m)
+                for ft in self.full_stream_tags:
+                    # full-stream subscribers (backup workers, log routers)
+                    # get every mutation via their own tag — the reference's
+                    # backup/txsTag and log-router tag fan-outs
+                    by_tag.setdefault(ft, []).append(m)
         # every TLog sees every version (its prev->version chain must advance
         # even on empty batches) but only stores its own tags' mutations
         per_tlog: list[dict[str, list[Mutation]]] = [dict() for _ in self.tlogs]

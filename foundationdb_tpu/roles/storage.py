@@ -81,7 +81,9 @@ class MemoryKeyValueStore:
 
     def bytes_range(self, begin: bytes, end: bytes) -> int:
         """Stored bytes in [begin, end) — the StorageMetrics size half (the
-        reference splits shards on BYTES, not key counts)."""
+        reference splits shards on BYTES, not key counts).  O(range) scan:
+        this engine is the simulation-scale store; the ssd engine answers
+        the same query from its directory's running sums in O(log n)."""
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
         return sum(
@@ -491,15 +493,36 @@ class StorageServer:
         rewrite-heavy window does not inflate the metric."""
         n = self.store.count_range(begin, end)
         bts = self.store.bytes_range(begin, end)
+        # un-flushed range-clears hide base data: subtract their (disjoint)
+        # committed coverage, or a just-cleared shard still looks split-hot
+        merged: list[tuple[bytes, bytes]] = []
+        for _v, cb, ce in sorted(self.overlay._clears, key=lambda c: c[1]):
+            b2, e2 = max(cb, begin), min(ce, end)
+            if b2 >= e2:
+                continue
+            if merged and b2 <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e2))
+            else:
+                merged.append((b2, e2))
+        for cb, ce in merged:
+            n -= self.store.count_range(cb, ce)
+            bts -= self.store.bytes_range(cb, ce)
+        def in_merged(k: bytes) -> bool:
+            j = bisect.bisect_right(merged, (k, b"\xff" * 40)) - 1
+            return j >= 0 and merged[j][0] <= k < merged[j][1]
+
         for k in self.overlay.overlay_keys_in(begin, end):
             chain = self.overlay._chains.get(k)
             newest = chain[-1][1] if chain else None
-            in_base = self.store.get(k) is not None
+            base_val = self.store.get(k)
             if newest is _CLEARED:
-                if in_base:
+                # point tombstone; keys under a merged clear were already
+                # subtracted wholesale above
+                if base_val is not None and not in_merged(k):
                     n -= 1
-                    bts -= len(k)  # value size unknown without a read
-            elif not in_base:
+                    bts -= len(k) + len(base_val)
+            elif base_val is None or in_merged(k):
+                # new in the window, or re-set on top of a pending clear
                 n += 1
                 bts += len(k) + (
                     len(newest) if isinstance(newest, (bytes, bytearray)) else 0
