@@ -100,3 +100,64 @@ def test_machine_kill_then_power_loss_roundtrip():
     assert len(rows) == 60
     assert all(v == b"v%d" % i for i, (_k, v) in enumerate(rows))
     c2.stop()
+
+
+@pytest.mark.parametrize("seed", [1601, 1602, 1603])
+def test_total_feature_chaos_sweep(seed):
+    """The widest configuration the framework supports, under chaos: worker
+    bootstrap on a machine/DC topology, ssd engine, a remote region's log
+    router + replicas, a live backup, buggify + randomized knobs, attrition
+    — and every invariant still holds."""
+    from foundationdb_tpu.client.backup import BackupAgent, BackupContainer
+    from foundationdb_tpu.workloads.increment import IncrementWorkload
+
+    c = RecoverableCluster(
+        seed=seed, n_storage_shards=2, storage_replication=2,
+        n_machines=4, n_dcs=2, n_workers=8, storage_engine="ssd",
+        remote_region=True, chaos=True,
+    )
+    agent = BackupAgent(c)
+    cont = BackupContainer(c.fs, f"bk-sink-{seed}")
+    c.run_until(c.loop.spawn(agent.start(cont)), 300)
+
+    cyc = CycleWorkload(nodes=6, clients=2, txns_per_client=4)
+    inc = IncrementWorkload(counters=3, clients=2, adds_per_client=4)
+    att = AttritionWorkload(kills=1, interval=2.0, start_delay=0.8)
+    cons = ConsistencyCheckWorkload()
+    metrics = run_workloads(c, [cyc, inc, att, cons], deadline=900.0)
+    assert metrics["Cycle"]["committed"] == 8
+    assert metrics["Increment"]["committed"] == 8
+    assert c.controller.recoveries >= 1
+    assert metrics["ConsistencyCheck"]["shards_checked"] == 2
+
+    # the remote region converged through all of it
+    async def remote_check():
+        v = [0]
+        db = c.database()
+
+        async def fn(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fn)
+        for _ in range(600):
+            if all(ss.version.get() >= v[0] for ss in c.remote_storage):
+                return True
+            await c.loop.delay(0.1)
+        return False
+
+    assert c.run_until(c.loop.spawn(remote_check()), 900)
+    # and the backup kept up
+    async def bk():
+        v = [0]
+        db = c.database()
+
+        async def fn(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fn)
+        await agent.wait_backed_up_to(v[0], timeout=120.0)
+        await agent.stop()
+        return True
+
+    assert c.run_until(c.loop.spawn(bk()), 900)
+    c.stop()
