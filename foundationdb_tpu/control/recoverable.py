@@ -276,7 +276,10 @@ class RecoverableCluster:
                     from ..storage.kvstore import DurableMemoryKeyValueStore as cls_
 
                 shard, rep = ClusterController._parse_tag(tag)
-                path = f"ss{shard}r{rep}.kv"
+                if tag.startswith("remote-"):
+                    path = f"remote{shard}.kv"  # promoted-region lineage
+                else:
+                    path = f"ss{shard}r{rep}.kv"
                 if self.fs.exists(path if self.storage_engine != "ssd" else path + ".hdr"):
                     return cls_.recover(self.fs, path, proc)
                 return cls_(self.fs, path, proc)
@@ -354,6 +357,54 @@ class RecoverableCluster:
                     ),
                 )
             )
+
+    async def promote_remote_region(self) -> bool:
+        """Region failover's write half: adopt the remote replicas as the
+        PRIMARY storage set.  The keyServers map swaps to the remote tags
+        at a drained boundary, the remote servers re-point their pulls from
+        the log router to the primary TLogs (they rejoin by tag, like any
+        storage server), and clients' views refresh — writes and reads now
+        flow through the former read replicas.  The reference's fearless
+        failover does this via region configuration + DD; the drained map
+        swap is this runtime's equivalent serialization point."""
+        cc = self.controller
+        for ss in self.remote_storage:
+            cc._tag_to_ss[ss.tag] = ss
+            if ss not in cc.storage:
+                cc.storage.append(ss)
+        splits = list(self._initial_storage_splits)
+        teams = [[f"remote-{i}-r0"] for i in range(len(splits) + 1)]
+        vm = await cc.install_storage_assignment(splits, teams)
+        if vm is None:
+            return False
+        await cc.persist_key_servers(splits, teams)
+        # versions <= vm exist only in the router's relay; the router keeps
+        # relaying until every promoted server is PAST the boundary, and
+        # only then do they rejoin the primary TLogs (whose remote-tag
+        # entries start at vm) — no version gap at the handoff
+        for ss in self.remote_storage:
+            while ss.version.get() < vm:
+                await self.loop.delay(0.05)
+        gen = cc.generation
+        from ..roles.logrouter import ROUTER_TAG
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        for ss in self.remote_storage:
+            tlog = gen.tlogs[cc._tag_tlogs(ss.tag)[0]]
+            ss.set_tlog_source(
+                _Ref(self.net, ss.process, tlog.peek_stream.endpoint),
+                _Ref(self.net, ss.process, tlog.pop_stream.endpoint),
+            )
+            self.dd._watch(ss)  # healing now covers the promoted servers
+        # the relay is no longer between the remotes and the write path
+        await cc.disable_stream_consumer(ROUTER_TAG)
+        self.log_router.stop()
+        self.log_router = None
+        for view in cc.views:
+            if getattr(view, "pinned_smap", None) is None:
+                cc._fill_view(view)
+        self.trace.trace("RegionPromoted", Tags=[s.tag for s in self.remote_storage])
+        return True
 
     def remote_database(self) -> Database:
         """A client view whose READS route to the remote region's replicas

@@ -129,3 +129,52 @@ def test_router_survives_pipeline_recovery():
     na, nb = c.run_until(c.loop.spawn(wait_and_read()), 900)
     assert (na, nb) == (30, 30)
     c.stop()
+
+
+def test_region_failover_promotion():
+    """The write half of region failover: after TOTAL primary storage loss,
+    the remote replicas are PROMOTED into the keyServers map, rejoin the
+    primary TLogs by tag, and the cluster serves reads AND writes again."""
+    c = RecoverableCluster(seed=1804, n_storage_shards=2, storage_replication=2,
+                           remote_region=True)
+    db = c.database()
+    _put(c, db, 50)
+
+    async def main():
+        v = [0]
+
+        async def fn(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fn)
+        for _ in range(600):
+            if all(ss.version.get() >= v[0] for ss in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        # region disaster
+        for ss in c.storage:
+            if ss.tag.startswith("ss-"):
+                ss.process.kill()
+        ok = await c.promote_remote_region()
+        assert ok, "promotion failed"
+        # WRITES flow again, onto the promoted replicas
+        async def put(tr):
+            for i in range(50, 70):
+                tr.set(b"mr%04d" % i, b"v%d" % i)
+
+        await db.run(put)
+
+        async def read(tr):
+            return await tr.get_range(b"mr", b"ms", limit=10000)
+
+        return await db.run(read)
+
+    rows = c.run_until(c.loop.spawn(main()), 900)
+    assert len(rows) == 70
+    assert all(v == b"v%d" % i for i, (_k, v) in enumerate(rows))
+    # promoted servers are in the serving map; the router is gone
+    assert all(
+        t[0].startswith("remote-") for t in c.controller.storage_teams_tags
+    )
+    assert c.log_router is None
+    c.stop()
