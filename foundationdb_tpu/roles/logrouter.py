@@ -55,8 +55,8 @@ class LogRouter:
             t: [] for team in remote_map.members for t in team
         }
         self._remote_pops: dict[str, Version] = {t: start_version for t in self._tags}
-        self.peek_stream = RequestStream(process, self.WLT_PEEK)
-        self.pop_stream = RequestStream(process, self.WLT_POP)
+        self.peek_stream = RequestStream(process, self.WLT_PEEK, unique=True)
+        self.pop_stream = RequestStream(process, self.WLT_POP, unique=True)
         self._tasks = [
             loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, "router-pull"),
             loop.spawn(self._serve_peek(), TaskPriority.STORAGE_SERVER, "router-peek"),

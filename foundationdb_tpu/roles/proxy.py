@@ -137,6 +137,7 @@ class CommitProxy:
         self.on_commit_failure = None  # controller hook: escalate to recovery
         self._req_num = 0
         self._failed = False
+        self._stopping = False
         self._grv_tokens = 10.0
         self._grv_batch_tokens = 0.0
         self._grv_refill_at = loop.now()
@@ -147,15 +148,16 @@ class CommitProxy:
         # (getLiveCommittedVersion, MasterProxyServer.actor.cpp:1002).
         self.peers: list[RequestStreamRef] = []
         self.tlog_confirms = tlog_confirm_refs or []
-        self.commit_stream = RequestStream(process, self.WLT_COMMIT)
-        self.grv_stream = RequestStream(process, self.WLT_GRV)
-        self.raw_version_stream = RequestStream(process, self.WLT_RAW)
+        self.commit_stream = RequestStream(process, self.WLT_COMMIT, unique=True)
+        self.grv_stream = RequestStream(process, self.WLT_GRV, unique=True)
+        self.raw_version_stream = RequestStream(process, self.WLT_RAW, unique=True)
         self.counters = CounterCollection("Proxy")
         self.c_committed = self.counters.counter("txns_committed")
         self.c_conflicted = self.counters.counter("txns_conflicted")
         self.c_batches = self.counters.counter("commit_batches")
         self.c_throttled = self.counters.counter("mvcc_window_throttles")
         self._pending: list[_PendingCommit] = []
+        self._batch_tasks: list = []  # in-flight commit batches (stop() kills)
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
         self._paused = 0        # drain barrier refcount (rebalance + DD)
         self._inflight = 0      # commit batches between spawn andcompletion
@@ -243,10 +245,12 @@ class CommitProxy:
                 # oversized ticks split into sequential pipelined batches
                 cap = max(self.knobs.COMMIT_BATCH_MAX_COUNT, 1)
                 for i in range(0, max(len(batch), 1), cap):
-                    self.loop.spawn(
+                    t = self.loop.spawn(
                         self._commit_batch(batch[i : i + cap]),
                         TaskPriority.PROXY_COMMIT,
                     )
+                    self._batch_tasks.append(t)
+                self._batch_tasks = [t for t in self._batch_tasks if not t.done()]
             else:
                 idle += self._batch_interval
 
@@ -281,7 +285,7 @@ class CommitProxy:
             # (NativeAPI.actor.cpp:2482-2502).
             for pc in batch:
                 pc.reply_cb.reply(CommitReply(CommitResult.UNKNOWN))
-            if not self._failed:
+            if not self._failed and not self._stopping:
                 self._failed = True
                 self.counters.counter("commit_path_failures").add(1)
                 if self.on_commit_failure is not None:
@@ -634,8 +638,15 @@ class CommitProxy:
                 r.reply(GetReadVersionReply(version))
 
     def stop(self) -> None:
+        self._stopping = True  # cancellation is teardown, not a failure
         for t in self._tasks:
             t.cancel()
+        # a deposed proxy's in-flight batches must NOT complete later: the
+        # cancelled batch answers UNKNOWN, and the client's fence dance
+        # decides the truth (the phantom-ack hole a zombie batch opens)
+        for t in self._batch_tasks:
+            t.cancel()
+        self._batch_tasks = []
         self.commit_stream.close()
         self.grv_stream.close()
         self.raw_version_stream.close()

@@ -51,7 +51,7 @@ class Resolver:
         self.knobs = knobs
         self.cs = conflict_set
         self.version = NotifiedVersion(start_version)
-        self.stream = RequestStream(process, self.WLT)
+        self.stream = RequestStream(process, self.WLT, unique=True)
         self.counters = CounterCollection("Resolver")
         self.c_batches = self.counters.counter("batches")
         self.c_txns = self.counters.counter("txns")
@@ -70,7 +70,7 @@ class Resolver:
         # their history lives on the donor, so any read below it must
         # conservatively conflict (same family as recovery state-evaporation)
         self._moved_in: list[tuple[bytes, bytes | None, Version]] = []
-        self.metrics_stream = RequestStream(process, self.WLT_METRICS)
+        self.metrics_stream = RequestStream(process, self.WLT_METRICS, unique=True)
         self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
         self._metrics_task = loop.spawn(
             self._serve_metrics(), TaskPriority.RESOLVER, "resolver-metrics"

@@ -71,7 +71,7 @@ class Sequencer:
         self._max_committed: Version = start_version
         self._epoch_start = loop.now()
         self._version_at_epoch = start_version
-        self.stream = RequestStream(process, self.WLT)
+        self.stream = RequestStream(process, self.WLT, unique=True)
         # per-proxy reply cache keyed by request_num: a retried request_num
         # re-receives its own (prev, version) pair instead of burning a fresh
         # version (the reference's per-proxy requestNum dedup in getVersion).

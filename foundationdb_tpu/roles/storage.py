@@ -315,9 +315,9 @@ class StorageServer:
         # above its snapshot version)
         self._fetching: list[_FetchState] = []
         self._range_floor: list[tuple[bytes, bytes, Version]] = []
-        self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE)
-        self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES)
-        self.watch_stream = RequestStream(process, self.WLT_WATCH)
+        self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
+        self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
+        self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
         self._watches: dict[bytes, list] = {}  # key -> [(expected, req)]
         self._tasks = [
             loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, f"ss-pull-{tag}"),

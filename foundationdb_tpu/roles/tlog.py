@@ -93,6 +93,11 @@ class TLog:
         self.process = process
         self.sync_delay = sync_delay
         self.version = NotifiedVersion(start_version)
+        # this epoch's floor: versions at or below it predate this TLog and
+        # were NEVER stored here — the duplicate-ack path must refuse them
+        # (a deposed proxy's stale push must time out, not get a phantom
+        # ack from a successor role that happens to share its process)
+        self._epoch_start = start_version
         # highest version known committed cluster-wide (acked by EVERY TLog
         # replica) — storage durability must never pass it
         self.known_committed = known_committed
@@ -132,11 +137,11 @@ class TLog:
             # WRITING_CSTATE).
             self.dq.push(_encode_reset(start_version, known_committed, self._tags))
         self._poppable: dict[str, Version] = {}
-        self.commit_stream = RequestStream(process, self.WLT_COMMIT)
-        self.peek_stream = RequestStream(process, self.WLT_PEEK)
-        self.pop_stream = RequestStream(process, self.WLT_POP)
-        self.lock_stream = RequestStream(process, self.WLT_LOCK)
-        self.confirm_stream = RequestStream(process, self.WLT_CONFIRM)
+        self.commit_stream = RequestStream(process, self.WLT_COMMIT, unique=True)
+        self.peek_stream = RequestStream(process, self.WLT_PEEK, unique=True)
+        self.pop_stream = RequestStream(process, self.WLT_POP, unique=True)
+        self.lock_stream = RequestStream(process, self.WLT_LOCK, unique=True)
+        self.confirm_stream = RequestStream(process, self.WLT_CONFIRM, unique=True)
         self._tasks = [
             loop.spawn(self._serve_commit(), TaskPriority.TLOG_COMMIT, "tlog-commit"),
             loop.spawn(self._serve_peek(), TaskPriority.TLOG_COMMIT, "tlog-peek"),
@@ -162,6 +167,8 @@ class TLog:
         if self.locked:
             return
         if self.version.get() >= r.version:
+            if r.version <= self._epoch_start:
+                return  # predates this epoch: not ours, never ack
             # duplicate push (proxy retry): already logged, ack again
             req.reply(r.version)
             return
@@ -180,6 +187,8 @@ class TLog:
         if self.locked:
             return  # locked mid-sync: unacked data is lost with the epoch
         if self.version.get() >= r.version:
+            if r.version <= self._epoch_start:
+                return  # predates this epoch: not ours, never ack
             req.reply(r.version)  # raced with a duplicate during the sync
             return
         for tag, muts in r.mutations_by_tag.items():

@@ -99,9 +99,19 @@ class ReceivedRequest:
 class RequestStream:
     """Server-side stream of typed requests at a (usually well-known) token."""
 
-    def __init__(self, process: SimProcess, token: str | None = None) -> None:
+    def __init__(self, process: SimProcess, token: str | None = None,
+                 unique: bool = False) -> None:
         self._process = process
-        self._token = token or ("rs:" + process.new_token())
+        if token is None:
+            self._token = "rs:" + process.new_token()
+        elif unique:
+            # per-INSTANCE endpoint: successive generations' roles may share
+            # a worker process, and a well-known token would make a deposed
+            # role's callers silently reach its successor (role interfaces
+            # in the reference carry UID-based tokens for exactly this)
+            self._token = f"{token}:{process.new_token()}"
+        else:
+            self._token = token
         self.requests = FutureStream()
         process.register(self._token, self._on_message)
 

@@ -124,3 +124,43 @@ def test_worker_cluster_durability_roundtrip():
     rows = _commit_n(c2, c2.database(), 0)
     assert len(rows) == 15
     c2.stop()
+
+
+def test_tlog_refuses_pre_epoch_versions():
+    """A TLog must never duplicate-ack a version at or below its epoch
+    start: such a push comes from a DEPOSED generation's zombie batch that
+    reached a successor role (regression for the phantom-ack hole found by
+    the chaos soak — the client would get COMMITTED for data nobody
+    stored)."""
+    from foundationdb_tpu.roles.tlog import TLog
+    from foundationdb_tpu.roles.types import TLogCommitRequest
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.rpc.stream import RequestStreamRef
+    from foundationdb_tpu.runtime.core import (
+        DeterministicRandom,
+        EventLoop,
+        TimedOut,
+    )
+    from foundationdb_tpu.runtime.trace import TraceCollector
+
+    loop = EventLoop()
+    net = SimNetwork(loop, DeterministicRandom(1), TraceCollector())
+    p = net.create_process("tlog")
+    t = TLog(p, loop, start_version=2_000_000, sync_delay=0.0)
+    cc = net.create_process("caller")
+    ref = RequestStreamRef(net, cc, t.commit_stream.endpoint)
+
+    async def main():
+        # a stale push from a deposed generation (version below the epoch
+        # start): must NOT be acked — the caller times out instead
+        try:
+            await ref.get_reply(
+                TLogCommitRequest(1_110_000, 1_111_171, {}, known_committed=0),
+                timeout=0.5,
+            )
+            return "acked"
+        except TimedOut:
+            return "refused"
+
+    assert loop.run_until(loop.spawn(main()), 60) == "refused"
+    t.stop()
