@@ -155,11 +155,14 @@ class DataDistributor:
         store = self.store_factory(tag, proc)
         gen = cc.generation
         tlog = gen.tlogs[cc._tag_tlogs(tag)[0]]
-        # start below every surviving replica's applied version: mutations
-        # between start and the fetch snapshot are covered by the snapshot,
-        # and the tag stream fills in everything after
+        # start at the survivors' KNOWN-COMMITTED floor, never their applied
+        # version: applied may include single-replica phantoms a recovery
+        # later rolls back, and the replacement's durable_version initializes
+        # to this start — a phantom start would trip the rewire's
+        # durability-bound assert.  Anything between start and the fetch
+        # snapshot is covered by the snapshot; the tag stream fills the rest.
         start_v = min(
-            (cc._tag_to_ss[t].version.get() for _b, _e, ts in ranges for t in ts),
+            (cc._tag_to_ss[t].known_committed for _b, _e, ts in ranges for t in ts),
             default=0,
         )
         new_ss = StorageServer(

@@ -59,7 +59,12 @@ class SimFile:
             TaskPriority.DISK_IO,
         )
         if self._process is not None and not self._process.alive:
-            return  # killed mid-fsync: buffers already dropped
+            # killed mid-fsync: the buffers are already dropped and NOTHING
+            # was made durable — returning normally would let the caller
+            # ack durability it does not have (a dying TLog acking a commit
+            # its disk never saw, the phantom the recovery-version rule
+            # exists to exclude).  The dead process's code must see failure.
+            raise IOError(f"{self.path}: process died during fsync")
         if self._st.pending_truncate:
             self._st.synced = bytearray()
             self._st.pending_truncate = False
