@@ -161,3 +161,62 @@ def test_backup_survives_pipeline_recovery():
     assert got == want
     assert len(got) == 30
     dst.stop()
+
+
+def test_backup_restore_exact_under_chaos():
+    """Chaos + attrition while a backup runs: the restored cluster matches
+    the source byte-for-byte (the soak's backup dimension, one seed in CI)."""
+    from foundationdb_tpu.runtime import buggify
+    from foundationdb_tpu.workloads.attrition import AttritionWorkload
+    from foundationdb_tpu.workloads.base import run_workloads
+    from foundationdb_tpu.workloads.cycle import CycleWorkload
+    from foundationdb_tpu.workloads.increment import IncrementWorkload
+
+    try:
+        src = RecoverableCluster(seed=3205, n_storage_shards=2,
+                                 storage_replication=2, chaos=True)
+        agent = BackupAgent(src)
+        cont = BackupContainer(src.fs, "bk-chaos")
+        src.run_until(src.loop.spawn(agent.start(cont)), 300)
+        src.run_until(src.loop.spawn(agent.snapshot(cont, chunk_rows=16)), 600)
+        cyc = CycleWorkload(nodes=6, clients=2, txns_per_client=4)
+        inc = IncrementWorkload(counters=3, clients=2, adds_per_client=4)
+        att = AttritionWorkload(kills=1, interval=2.0, start_delay=0.8)
+        run_workloads(src, [cyc, inc, att], deadline=900.0)
+        db = src.database()
+
+        async def settle():
+            v = [0]
+
+            async def fn(tr):
+                v[0] = await tr.get_read_version()
+
+            await db.run(fn)
+            await agent.wait_backed_up_to(v[0], timeout=120.0)
+            await agent.stop()
+
+            async def fn2(tr):
+                return await tr.get_range(b"", b"\xff", limit=100000)
+
+            return await db.run(fn2)
+
+        want = src.run_until(src.loop.spawn(settle()), 900)
+        src.stop()
+    finally:
+        buggify.disable()
+
+    dst = RecoverableCluster(seed=8205, n_storage_shards=2,
+                             storage_replication=2)
+    db2 = dst.database()
+
+    async def do_restore():
+        await restore(db2, cont)
+
+        async def fn(tr):
+            return await tr.get_range(b"", b"\xff", limit=100000)
+
+        return await db2.run(fn)
+
+    got = dst.run_until(dst.loop.spawn(do_restore()), 900)
+    dst.stop()
+    assert got == want
