@@ -164,3 +164,29 @@ def test_total_feature_chaos_sweep(seed):
 
     assert c.run_until(c.loop.spawn(bk()), 900)
     c.stop()
+
+
+def test_device_lsm_kernel_in_chaos_cluster():
+    """The LSM device kernel as the RESOLVER backend of a full chaos
+    cluster (2 resolvers, worker bootstrap, attrition): the cluster-level
+    invariants exercise the kernel through recoveries — fresh conflict
+    sets per generation, GC via remove_before, pipelined verdicts."""
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+    from foundationdb_tpu.workloads.increment import IncrementWorkload
+
+    c = RecoverableCluster(
+        seed=4100, n_storage_shards=2, storage_replication=2,
+        n_resolvers=2, n_workers=6, chaos=True,
+        conflict_backend=lambda oldest=0: DeviceConflictSet(
+            oldest, capacity=1 << 10, lsm=True, recent_capacity=256
+        ),
+    )
+    cyc = CycleWorkload(nodes=6, clients=2, txns_per_client=4)
+    inc = IncrementWorkload(counters=3, clients=2, adds_per_client=4)
+    att = AttritionWorkload(kills=1, interval=2.0, start_delay=0.8)
+    cons = ConsistencyCheckWorkload()
+    m = run_workloads(c, [cyc, inc, att, cons], deadline=900.0)
+    assert m["Cycle"]["committed"] == 8
+    assert m["Increment"]["committed"] == 8
+    assert c.controller.recoveries >= 1
+    c.stop()
