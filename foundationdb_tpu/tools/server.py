@@ -1,0 +1,66 @@
+"""Production server entrypoint — the `fdbserver` main analog
+(fdbserver/fdbserver.actor.cpp main; flow/Net2 run loop).
+
+    python -m foundationdb_tpu.tools.server [--port P] [--shards N]
+           [--replication R] [--engine memory|ssd] [--workers W]
+           [--trace-file PATH]
+
+Boots a complete cluster (coordinators, worker-recruited write pipeline,
+replicated storage, data distribution, ratekeeper) in this OS process,
+anchored to the WALL clock, and serves the client gateway protocol on
+--port (the C ABI / bindings surface, tools/gateway.py).  The fdbcli
+shell and any FFI client connect to that port.
+
+One process hosts the whole simulation-grade cluster: the deterministic
+runtime is the same, only the clock driver differs (the Net2/Sim2 seam).
+Multi-OS-process deployment rides rpc/transport.py's real TCP fabric."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--replication", type=int, default=2)
+    ap.add_argument("--engine", choices=("memory", "ssd"), default="ssd")
+    ap.add_argument("--workers", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--run-seconds", type=float, default=None,
+                    help="exit after N wall seconds (default: run forever)")
+    args = ap.parse_args(argv)
+
+    from ..control.recoverable import RecoverableCluster
+    from .gateway import ClientGateway, GatewayDriver
+
+    sink = open(args.trace_file, "a") if args.trace_file else None
+    cluster = RecoverableCluster(
+        seed=args.seed,
+        n_storage_shards=args.shards,
+        storage_replication=args.replication,
+        storage_engine=args.engine,
+        n_workers=args.workers,
+        trace_sink=sink,
+    )
+    gw = ClientGateway(cluster.loop, cluster.database(), port=args.port)
+    print(f"fdbtpu server ready on 127.0.0.1:{gw.port}", flush=True)
+    try:
+        GatewayDriver(cluster.loop, gw).serve_forever(
+            wall_timeout=args.run_seconds
+        )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.close()
+        cluster.stop()
+        if sink:
+            sink.close()
+        print("fdbtpu server stopped", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -192,3 +192,39 @@ def test_bindingtester_conformance(clib, gateway):
     c.stop()
 
     assert c_results == py_results
+
+
+def test_server_entrypoint(clib):
+    """The fdbserver-main analog: `python -m foundationdb_tpu.tools.server`
+    boots a whole cluster + gateway; a compiled C client transacts
+    against it."""
+    import os
+    import tempfile
+
+    errf = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.server",
+         "--port", "0", "--engine", "ssd", "--run-seconds", "60"],
+        stdout=subprocess.PIPE, stderr=errf, text=True,
+        env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO)},
+        cwd=str(REPO),
+    )
+    try:
+        ready, _, _ = select.select([proc.stdout], [], [], 25.0)
+        line = proc.stdout.readline() if ready else ""
+        if "ready on" not in line:
+            proc.kill()
+            errf.seek(0)
+            raise AssertionError(f"server never started: {errf.read()[-2000:]}")
+        port = int(line.rsplit(":", 1)[1])
+        r = subprocess.run(
+            [str(CDIR / "ctest"), "127.0.0.1", str(port)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, f"ctest vs server failed:\n{r.stdout}\n{r.stderr}"
+        assert r.stdout.startswith("C-OK ")
+    finally:
+        proc.kill()
+        proc.wait()
+        errf.close()
