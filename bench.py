@@ -153,46 +153,103 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+_PROBE_SRC = """
+import time, sys
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.arange(64, dtype=jnp.int32)
+int((x * x).sum().block_until_ready())  # round-trip through the device
+print(f"PROBE_OK {jax.default_backend()} {time.time() - t0:.1f}s")
+"""
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: re-runs of the bench (and the
+    autotune, when enabled) skip every compile they have seen before —
+    compile time is exactly what a flaky device tunnel punishes most."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(f"[bench] compile cache unavailable: {e!r}", file=sys.stderr)
+
+
 def _init_backend(timeout_s: float, retries: int = 3) -> dict:
     """Initialize the JAX backend defensively.
 
     The axon TPU tunnel in this environment can hang for minutes or die
     with Unavailable; a bench that crashes before printing ANY number is
-    worthless (round-1 lesson: BENCH_r01 was rc=1 with no output).  Run
-    jax.devices() on a daemon thread with a timeout, retry with backoff on
-    errors, and report failure as data instead of dying."""
+    worthless (round-1 lesson: BENCH_r01 was rc=1 with no output) and a
+    bench that gives up after ONE hung attempt records nothing (round-4
+    lesson: BENCH_r04).  A hung in-process PJRT init cannot be retried —
+    the C++ layer holds global state — so each attempt probes the tunnel
+    in a SUBPROCESS that a timeout can actually kill, with backoff between
+    attempts; only after a probe succeeds does the in-process init run
+    (itself on a daemon thread with a timeout, in case the tunnel dies in
+    the gap).  Failure is reported as data instead of dying."""
+    import subprocess
     import threading
     import traceback
 
+    retries = int(os.environ.get("BENCH_INIT_ATTEMPTS", str(retries)))
     result: dict = {}
     for attempt in range(retries):
-        state: dict = {}
-
-        def target() -> None:
-            try:
-                import jax
-
-                state["devices"] = jax.devices()
-                state["backend"] = jax.default_backend()
-            except Exception:  # noqa: BLE001 — reported as data
-                state["error"] = traceback.format_exc(limit=3)
-
-        t = threading.Thread(target=target, daemon=True)
-        t.start()
-        t.join(timeout_s)
-        if t.is_alive():
-            result["error"] = f"backend init hung > {timeout_s}s (attempt {attempt + 1})"
-            # a hung PJRT init rarely un-hangs; don't stack more hung threads
-            return result
-        if "backend" in state:
-            return state
-        result["error"] = state.get("error", "unknown init failure")
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
+            detail = (proc.stdout + proc.stderr).strip().splitlines()
+            detail = detail[-1][:300] if detail else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"probe hung > {timeout_s}s (killed)"
+        dt = time.perf_counter() - t0
+        if ok:
+            print(f"[bench] probe OK in {dt:.1f}s: {detail}", file=sys.stderr)
+            break
+        result["error"] = detail
         print(
-            f"[bench] backend init failed (attempt {attempt + 1}/{retries}); "
-            f"retrying: {result['error'].splitlines()[-1] if result.get('error') else '?'}",
+            f"[bench] probe attempt {attempt + 1}/{retries} failed after "
+            f"{dt:.1f}s: {detail}",
             file=sys.stderr,
         )
-        time.sleep(2.0 * (attempt + 1))
+        if attempt + 1 < retries:
+            time.sleep(20.0 * (attempt + 1))
+    else:
+        return result
+
+    # tunnel answers: init in-process (still guarded — it can die in the gap)
+    state: dict = {}
+
+    def target() -> None:
+        try:
+            _enable_compile_cache()
+            import jax
+
+            state["devices"] = jax.devices()
+            state["backend"] = jax.default_backend()
+        except Exception:  # noqa: BLE001 — reported as data
+            state["error"] = traceback.format_exc(limit=3)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        result["error"] = f"in-process init hung > {timeout_s}s after probe OK"
+        return result
+    if "backend" in state:
+        return state
+    result["error"] = state.get("error", "unknown init failure")
     return result
 
 
@@ -271,27 +328,39 @@ def main() -> None:
         )
 
 
+# Best-known configuration on TPU, committed so the default timed path needs
+# no exploratory compiles at all (VERDICT r4 #1a).  Measured on the real chip:
+# the LSM state confines the per-batch merge to the recent level, the sort
+# twins avoid TPU's serialized scatter/gather lowerings.  Override with
+# FDBTPU_SEARCH_IMPL / FDBTPU_MERGE_IMPL / FDBTPU_LSM, or set BENCH_AUTOTUNE=1
+# to re-measure all combos on the live device.
+BEST_KNOWN = ("sort", "sort", True)
+
+
 def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
     """Pick the fastest (search_impl, merge_impl, lsm) combo ON THIS DEVICE.
 
     XLA's lowering quality for scatters/gathers vs sorts differs wildly
     across backends (TPU scatters serialize per row; sorts are tuned
     networks — and the CPU backend inverts that), so the kernel ships both
-    implementations of its two heavy phases and the bench measures which
-    combination wins before taking the headline number.  Disable with
-    BENCH_AUTOTUNE=0 (then FDBTPU_SEARCH_IMPL/FDBTPU_MERGE_IMPL decide)."""
+    implementations of its two heavy phases and the bench can measure which
+    combination wins before taking the headline number.  OPT-IN with
+    BENCH_AUTOTUNE=1; the default path uses the committed BEST_KNOWN combo
+    (one compile, flaky-tunnel insurance) with env overrides honored."""
     import jax
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet
 
-    if os.environ.get("BENCH_AUTOTUNE", "1") == "0":
+    if os.environ.get("BENCH_AUTOTUNE", "0") != "1":
         from foundationdb_tpu.conflict.device import impl_from_env
 
-        si = impl_from_env("search")
-        mi = impl_from_env("merge")
-        lsm = os.environ.get("FDBTPU_LSM", "") == "1"
+        si = impl_from_env("search", override=os.environ.get(
+            "FDBTPU_SEARCH_IMPL", BEST_KNOWN[0]))
+        mi = impl_from_env("merge", override=os.environ.get(
+            "FDBTPU_MERGE_IMPL", BEST_KNOWN[1]))
+        lsm = os.environ.get("FDBTPU_LSM", "1" if BEST_KNOWN[2] else "") == "1"
         print(
-            f"[bench] autotune off: search={si} merge={mi} lsm={int(lsm)}",
+            f"[bench] autotune off (best-known): search={si} merge={mi} lsm={int(lsm)}",
             file=sys.stderr,
         )
         return si, mi, lsm
@@ -372,6 +441,11 @@ def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
     )
     for b in prefill:
         dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
+    if lsm:
+        # compile the compaction kernel OUTSIDE the timed window and start
+        # the timed stream with an empty recent level (compactions that fire
+        # mid-stream are still timed — that's the honest amortized cost)
+        dev._compact()
     # pre-stage the packed batches on device: in production the resolver
     # sits on the TPU host (PCIe DMA, ~60us for these ~1MB batches); in this
     # dev environment the device is behind a network tunnel, so per-batch

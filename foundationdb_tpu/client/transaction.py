@@ -270,6 +270,7 @@ class Transaction:
         self.debug_id: str | None = None  # set by sampled create_transaction
         self._priority = 1  # TransactionPriority.DEFAULT
         self._causal_write_risky = False
+        self._lock_aware = False
 
     def set_option(self, option: bytes, value: bytes | None = None) -> None:
         """Transaction options (fdb_transaction_set_option; the generated
@@ -280,6 +281,8 @@ class Transaction:
           causal_write_risky          skip the self-conflict ranges that
                                       make the unknown-result fence certain
                                       (faster commits, weaker retry safety)
+          lock_aware                  commit through a locked database
+                                      (ManagementAPI lock/unlock)
           debug_transaction_identifier  value = id; join pipeline timelines
         """
         from ..roles.types import PRIORITY_BATCH, PRIORITY_IMMEDIATE
@@ -290,6 +293,8 @@ class Transaction:
             self._priority = PRIORITY_IMMEDIATE
         elif option == b"causal_write_risky":
             self._causal_write_risky = True
+        elif option == b"lock_aware":
+            self._lock_aware = True
         elif option == b"debug_transaction_identifier":
             if not value:
                 raise ValueError("debug_transaction_identifier needs a value")
@@ -339,6 +344,11 @@ class Transaction:
         a dummy's own unknown result is safe to retry (it is idempotent)."""
         for _ in range(50):
             dummy = self.db.create_transaction()
+            # always lock-aware (the reference's commitDummyTransaction sets
+            # LOCK_AWARE unconditionally): the fence must land even if the
+            # database was locked between the unknown commit and the retry —
+            # it writes nothing, it only settles the original's outcome
+            dummy._lock_aware = True
             dummy.add_read_conflict_range(key, key_after(key))
             dummy.add_write_conflict_range(key, key_after(key))
             try:
@@ -499,6 +509,7 @@ class Transaction:
             write_conflict_ranges=list(self._write_ranges),
             mutations=list(self._mutations),
             debug_id=self.debug_id,
+            lock_aware=self._lock_aware,
         )
         g_trace_batch.add("NativeAPI.commit.Before", self.debug_id)
         try:
@@ -521,4 +532,8 @@ class Transaction:
             raise TransactionTooOld()
         if reply.result == CommitResult.UNKNOWN:
             raise CommitUnknownResult()
+        if reply.result == CommitResult.DATABASE_LOCKED:
+            from ..roles.types import DatabaseLocked
+
+            raise DatabaseLocked()  # not retryable: on_error re-raises
         raise NotCommitted()

@@ -635,7 +635,11 @@ def resolve_core_lsm(
         rec_ks, rec_vs, wb, we, wb_rank, we_rank, w_ins, commit_off,
         cap=rec_cap,
     )
-    new_rec_bidx = _rebuild_buckets(new_rec_ks)
+    # the bucket index feeds only the bucketed search: with the sort search
+    # selected, skip the N_BUCKETS-sized scatter rebuild entirely
+    new_rec_bidx = (
+        rec_bidx if search_impl == "sort" else _rebuild_buckets(new_rec_ks)
+    )
 
     converged = conv_main & conv_rec
     ok = ok_in & converged & (new_rec_count <= rec_cap)
@@ -977,10 +981,13 @@ class DeviceConflictSet(ConflictSet):
             pre_ks, pre_vs, pre_dev_count = self._ks, self._vs, self._dev_count
             iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
             while True:
+                # ok_in as a device array so this shares ONE compiled
+                # executable with the pipelined path (a Python True traces
+                # as a weak-typed scalar => a second compile of the kernel)
                 verdict, new_ks, new_vs, new_count, new_bidx, conv, _ok = _resolve_kernel(
                     self._ks, self._vs, self._bidx, self._dev_count,
                     rbv, rev, rtv, wbv, wev, wtv,
-                    snap_p, active_p, commit_off,
+                    snap_p, active_p, commit_off, jnp.asarray(True),
                     cap=self._cap, n_txn=Bp, n_read=R, n_write=Wn,
                     search_iters=iters,
                     merge_impl=self._merge_impl,
@@ -1049,6 +1056,7 @@ class DeviceConflictSet(ConflictSet):
                 self._ks, self._vs, self._tab, self._bidx, self._dev_count,
                 self._rec_ks, self._rec_vs, self._rec_bidx, self._rec_dev_count,
                 rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
+                jnp.asarray(True),
                 cap=self._cap, rec_cap=self._rec_cap,
                 n_txn=Bp, n_read=R, n_write=Wn,
                 search_iters=iters, rec_iters=rec_iters,

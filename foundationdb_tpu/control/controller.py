@@ -164,6 +164,18 @@ class ClusterController:
         self.epoch = 0
         self.recoveries = 0
         self.resolver_moves = 0
+        # ManagementAPI state, fed by the `\xff/conf/` watch: exclusion
+        # targets (machine names / process names / addresses —
+        # excludedServersPrefix), the database lock UID, and the pending
+        # coordinator-change hook (installed by the cluster assembly, which
+        # owns coordinator construction)
+        self.excluded_targets: set[str] = set()
+        self._locked: bytes | None = None
+        self.on_coordinators_change = None  # async (n) -> bool
+        self._coordinator_count: int | None = None
+        self.maintenance_zones: dict[str, float] = {}  # zone -> deadline
+        self.replication_policy = None      # installed by the cluster assembly
+        self.on_redundancy_change = None    # async (policy) -> bool (one step)
         self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
         # full-stream consumers: tag -> worker (backup, log routers)
@@ -189,6 +201,36 @@ class ClusterController:
         coordinator placement."""
         return (i * ring_len) // max(n, 1) % ring_len
 
+    @staticmethod
+    def excluded_match(targets: set, *, machine=None, name=None, address=None) -> bool:
+        """THE exclusion-target matcher (machine name / process name /
+        address) — single source of truth for is_excluded, worker
+        recruitment, and management.exclusion_safe."""
+        return bool(targets) and (
+            machine in targets
+            or name in targets
+            or (address is not None and str(address) in targets)
+        )
+
+    def is_excluded(self, proc) -> bool:
+        """Does an exclusion target (ManagementAPI exclude) match this
+        process's locality?"""
+        return self.excluded_match(
+            self.excluded_targets,
+            machine=getattr(proc, "machine", None),
+            name=proc.name,
+            address=proc.address,
+        )
+
+    def _placement_ring(self) -> list[tuple[str, str]]:
+        """The machine ring minus excluded machines (falling back to the
+        full ring if exclusion would empty it — a misconfigured exclude-all
+        must not make recruitment impossible)."""
+        if not self.excluded_targets:
+            return self.machines
+        ring = [m for m in self.machines if m[0] not in self.excluded_targets]
+        return ring or self.machines
+
     def _new_proc(self, role: str, spread: tuple[int, int] | None = None) -> SimProcess:
         """spread=(i, n): place the i-th of n same-kind roles evenly across
         the machine ring — TLog/proxy replicas must straddle DCs, or one
@@ -196,13 +238,14 @@ class ClusterController:
         ReplicationPolicy Across(dcid))."""
         self._proc_seq += 1
         extra = {}
-        if self.machines:
+        ring = self._placement_ring()
+        if ring:
             if spread is not None:
                 i, n = spread
-                idx = self.spread_slot(i, n, len(self.machines))
+                idx = self.spread_slot(i, n, len(ring))
             else:
-                idx = self._proc_seq % len(self.machines)
-            m, d = self.machines[idx]
+                idx = self._proc_seq % len(ring)
+            m, d = ring[idx]
             extra = {"machine": m, "dc": d}
         return self.net.create_process(
             f"{role}-e{self.epoch}-{self._proc_seq}", **extra
@@ -311,6 +354,8 @@ class ClusterController:
                         self.fs.delete(path)
 
             self.generation = gen
+            for p in gen.proxies:
+                p.locked = self._locked  # the lock survives recoveries
             self._set_state(RecoveryState.ACCEPTING_COMMITS)
             self._rewire(gen, recovery_version if not first else None)
             self._set_state(RecoveryState.FULLY_RECOVERED)
@@ -701,6 +746,19 @@ class ClusterController:
         deadline = self.loop.now() + 5.0
         while True:
             cands = self._live_workers()
+            # excluded workers host nothing (ManagementAPI exclude) — unless
+            # every live worker is excluded, when refusing to recruit would
+            # wedge recovery entirely
+            non_ex = [
+                w for w in cands
+                if not self.excluded_match(
+                    self.excluded_targets,
+                    machine=w["machine"], name=w["name"],
+                    address=w["recruit_ep"].address,
+                )
+            ]
+            if non_ex:
+                cands = non_ex
             cands.sort(
                 key=lambda w: (
                     w["machine"] is not None and w["machine"] in avoid,
@@ -1153,7 +1211,13 @@ class ClusterController:
         reference's master reacts to txnStateStore config-key changes the
         same way (ManagementAPI.actor.cpp changeConfig; masterserver
         restarts on configuration version bump)."""
-        from ..client.management import CONF_PREFIX
+        from ..client.management import (
+            CONF_PREFIX,
+            COORDINATORS_KEY,
+            EXCLUDED_PREFIX,
+            LOCK_KEY,
+            MAINTENANCE_PREFIX,
+        )
 
         view = None
         while True:
@@ -1171,7 +1235,36 @@ class ClusterController:
             except Exception:  # noqa: BLE001 — recovery window; retry next tick
                 continue
             conf = {}
+            excluded: set[str] = set()
+            locked: bytes | None = None
+            coord_n: int | None = None
+            maint: dict[str, float] = {}
+            redundancy: str | None = None
             for k, v in rows:
+                if k.startswith(EXCLUDED_PREFIX):
+                    excluded.add(k[len(EXCLUDED_PREFIX):].decode())
+                    continue
+                if k == LOCK_KEY:
+                    locked = v
+                    continue
+                if k == COORDINATORS_KEY:
+                    try:
+                        coord_n = int(v)
+                    except ValueError:
+                        pass
+                    continue
+                if k.startswith(MAINTENANCE_PREFIX):
+                    try:
+                        maint[k[len(MAINTENANCE_PREFIX):].decode()] = float(v)
+                    except (ValueError, UnicodeDecodeError):
+                        pass
+                    continue
+                if k == CONF_PREFIX + b"redundancy":
+                    try:
+                        redundancy = v.decode()
+                    except UnicodeDecodeError:
+                        pass
+                    continue
                 try:
                     conf[k[len(CONF_PREFIX):].decode()] = int(v)
                 except (ValueError, UnicodeDecodeError):
@@ -1181,6 +1274,78 @@ class ClusterController:
             # committed reconfiguration could be dropped forever
             gen = self.generation
             if gen is None or self._recovering:
+                continue
+
+            # lock: applied to the live proxies directly (cheap, idempotent)
+            self._locked = locked
+            for p in gen.proxies:
+                p.locked = locked
+
+            # maintenance zones (fdbcli `maintenance`): healing suppression,
+            # consulted by data distribution; expired deadlines drop out
+            self.maintenance_zones = {
+                z: d for z, d in maint.items() if d > self.loop.now()
+            }
+
+            # coordinator-set change (changeQuorum): delegated to the
+            # assembly-installed hook, which owns Coordinator construction
+            if (
+                coord_n is not None
+                and coord_n != self._coordinator_count
+                and self.on_coordinators_change is not None
+            ):
+                try:
+                    if await self.on_coordinators_change(coord_n):
+                        self._coordinator_count = coord_n
+                        testcov("management.coordinators_changed")
+                        self.trace.trace(
+                            "CoordinatorsChanged", Count=coord_n, Epoch=self.epoch
+                        )
+                except Exception as e:  # noqa: BLE001 — next poll retries
+                    self.trace.trace("CoordinatorsChangeError", Error=repr(e))
+
+            # redundancy flip (configure redundancy=double/triple/...): data
+            # distribution converges one replica per poll until every team
+            # matches the policy's factor
+            if redundancy is not None and self.on_redundancy_change is not None:
+                try:
+                    from ..rpc.policy import policy_for_redundancy
+
+                    policy = policy_for_redundancy(redundancy)
+                    target = policy.replicas()
+                    if any(len(t) != target for t in self.storage_teams_tags):
+                        self.replication_policy = policy
+                        self._redundancy_pending = True
+                        await self.on_redundancy_change(policy)
+                    elif getattr(self, "_redundancy_pending", False):
+                        # transition to converged: every team now matches
+                        self._redundancy_pending = False
+                        testcov("management.redundancy_converged")
+                        self.trace.trace(
+                            "RedundancyChanged", Mode=redundancy,
+                            Epoch=self.epoch,
+                        )
+                except ValueError:
+                    self.trace.trace("RedundancyModeUnknown", Mode=redundancy)
+                except Exception as e:  # noqa: BLE001 — next poll retries
+                    self.trace.trace("RedundancyChangeError", Error=repr(e))
+
+            # exclusion: targets hosting pipeline roles force a recovery
+            # (recruitment avoids excluded machines/workers); storage drains
+            # via data distribution's exclusion loop.  The role check runs
+            # EVERY poll, not only on change — a failed recovery must be
+            # retried next tick
+            if excluded != self.excluded_targets:
+                self.excluded_targets = excluded
+                self.trace.trace(
+                    "ExclusionChanged", Targets=sorted(excluded), Epoch=self.epoch
+                )
+            if excluded and any(self.is_excluded(p) for p in gen.processes):
+                testcov("management.exclusion_recovery")
+                try:
+                    await self._recover()
+                except Exception:  # noqa: BLE001 — next poll retries
+                    pass
                 continue
             want_tlogs = conf.get("n_tlogs", len(gen.tlogs))
             want_proxies = conf.get("n_proxies", len(gen.proxies))

@@ -60,6 +60,7 @@ class DataDistributor:
         self.moves = 0
         self.heals = 0
         self.shard_splits = 0
+        self.exclusion_drains = 0
         self._moving = False
         self._seg_prev: tuple = (None, 0.0)  # write-rate differencing state
         self._metrics_tick = 0
@@ -72,6 +73,7 @@ class DataDistributor:
         self._tasks = [
             loop.spawn(self._heal_loop(), TaskPriority.COORDINATION, "dd-heal"),
             loop.spawn(self._split_loop(), TaskPriority.COORDINATION, "dd-split"),
+            loop.spawn(self._exclusion_loop(), TaskPriority.COORDINATION, "dd-exclude"),
         ]
 
     # -- failure detection ---------------------------------------------------
@@ -106,11 +108,29 @@ class DataDistributor:
                 try:
                     await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
                 except (TimedOut, BrokenPromise):
+                    if self._in_maintenance(ss):
+                        # fdbcli `maintenance`: the zone's processes are being
+                        # deliberately bounced — healing would churn data
+                        testcov("dd.maintenance_skip")
+                        continue
                     if cc._tag_to_ss.get(ss.tag) is ss:  # not already healed
                         try:
                             await self._heal(ss)
                         except (TimedOut, BrokenPromise):
                             continue  # mid-recovery; next tick retries
+
+    def _in_maintenance(self, ss: StorageServer) -> bool:
+        zones = getattr(self.cc, "maintenance_zones", {})
+        if not zones:
+            return False
+        now = self.loop.now()
+        return any(
+            d > now and z in (
+                getattr(ss.process, "machine", None),
+                getattr(ss.process, "dc", None),
+            )
+            for z, d in zones.items()
+        )
 
     async def _heal(self, dead: StorageServer) -> None:
         cc = self.cc
@@ -142,7 +162,11 @@ class DataDistributor:
                 cc._tag_to_ss[t].process.dc
                 for _b, _e, ts in ranges for t in ts
             }
-            forbidden = survivor_m | {getattr(dead.process, "machine", None)}
+            forbidden = (
+                survivor_m
+                | {getattr(dead.process, "machine", None)}
+                | cc.excluded_targets  # never heal ONTO an excluded machine
+            )
             ring = [
                 m for m in cc.machines
                 if m[0] not in forbidden and m[1] not in survivor_d
@@ -202,6 +226,318 @@ class DataDistributor:
         cc.trace.trace(
             "DDHealed", Tag=tag, Ranges=len(ranges), StartVersion=start_v,
         )
+
+    # -- exclusion drain (ManagementAPI exclude -> zero-loss retirement) -----
+    async def _exclusion_loop(self) -> None:
+        """Retire storage replicas on excluded targets: each gets a live
+        replacement on a non-excluded machine, data moved with zero loss
+        (the reference's DataDistribution reacting to excludedServersPrefix:
+        teams containing excluded servers are 'unhealthy' and rebuilt —
+        DataDistribution.actor.cpp teamTracker + excludedServers watch)."""
+        cc = self.cc
+        while True:
+            await self.loop.delay(self.knobs.DD_PING_INTERVAL, TaskPriority.COORDINATION)
+            if cc.generation is None or cc._recovering or not cc.excluded_targets:
+                continue
+            for ss in list(cc.storage):
+                if (
+                    cc._tag_to_ss.get(ss.tag) is ss
+                    and ss.process.alive
+                    and cc.is_excluded(ss.process)
+                    and not self._moving
+                ):
+                    # the drain and MoveKeys both mutate team state: mutual
+                    # exclusion via the same _moving flag move_range takes
+                    self._moving = True
+                    try:
+                        await self._drain(ss)
+                    except (TimedOut, BrokenPromise):
+                        continue  # mid-recovery; next tick retries
+                    finally:
+                        self._moving = False
+
+    async def _drain(self, victim: StorageServer) -> bool:
+        """Move a LIVE replica's responsibilities to a fresh server with
+        zero data loss.  Unlike _heal, the victim is alive throughout: it
+        keeps pulling and serving reads — it IS the snapshot source — but
+        its store file and tag-queue pops are frozen so the replacement
+        (which recovers that same file) is the only writer/popper."""
+        cc = self.cc
+        tag = victim.tag
+        bounds = [b""] + list(cc.storage_splits) + [None]
+        ranges: list[tuple[bytes, bytes | None, list[str]]] = []
+        for i, team in enumerate(cc.storage_teams_tags):
+            if tag in team:
+                # victim first: authoritative for its own tag, always live
+                ranges.append(
+                    (bounds[i], bounds[i + 1], [tag] + [t for t in team if t != tag])
+                )
+        if not ranges:
+            return True  # serves nothing: already drained
+        self._heal_seq += 1
+        src_servers = {
+            t: cc._tag_to_ss[t] for _b, _e, ts in ranges for t in ts
+        }
+        victim.freeze_writes()  # before the replacement reopens its file
+        extra = {}
+        if cc.machines:
+            mates_m = {
+                s.process.machine for s in src_servers.values() if s is not victim
+            }
+            mates_d = {
+                s.process.dc for s in src_servers.values() if s is not victim
+            }
+            forbidden = (
+                mates_m
+                | {getattr(victim.process, "machine", None)}
+                | cc.excluded_targets
+            )
+            ring = [
+                m for m in cc.machines
+                if m[0] not in forbidden and m[1] not in mates_d
+            ] or [m for m in cc.machines if m[0] not in forbidden] \
+              or cc._placement_ring()
+            m, d = ring[self._heal_seq % len(ring)]
+            extra = {"machine": m, "dc": d}
+        proc = self.net.create_process(f"storage-drain{self._heal_seq}-{tag}", **extra)
+        store = self.store_factory(tag, proc)
+        gen = cc.generation
+        tlog = gen.tlogs[cc._tag_tlogs(tag)[0]]
+        start_v = min(s.known_committed for s in src_servers.values())
+        new_ss = StorageServer(
+            proc, self.loop, self.knobs,
+            tlog_peek_ref=RequestStreamRef(self.net, proc, tlog.peek_stream.endpoint),
+            tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
+            tag=tag, store=store, start_version=start_v,
+        )
+        cc.replace_storage_server(victim, new_ss)
+        self._watch(new_ss)
+        futs = []
+        for b, e, src_tags in ranges:
+            refs = [
+                RequestStreamRef(
+                    self.net, proc, src_servers[t].getkv_stream.endpoint
+                )
+                for t in src_tags
+            ]
+            futs.append(new_ss.start_fetch(b, e, start_v, refs))
+        try:
+            await wait_all(futs)
+        except (TimedOut, BrokenPromise):
+            # drain could not complete (e.g. recovery churn): roll back to
+            # the live victim — its frozen state is intact, and any WAL
+            # entries the replacement flushed are valid same-tag data
+            for f in futs:
+                f.cancel()
+            new_ss.process.kill()
+            new_ss.stop()
+            cc.replace_storage_server(new_ss, victim)
+            self._watch(victim)
+            victim.unfreeze_writes()
+            # a recovery may have swapped generations mid-drain; _rewire only
+            # re-points servers in cc.storage (the replacement, at the time),
+            # so the reinstated victim must be re-pointed at the CURRENT
+            # generation or it would pull from a dead TLog forever
+            gen2 = cc.generation
+            if gen2 is not None:
+                tlog2 = gen2.tlogs[cc._tag_tlogs(tag)[0]]
+                victim.set_tlog_source(
+                    RequestStreamRef(
+                        self.net, victim.process, tlog2.peek_stream.endpoint
+                    ),
+                    RequestStreamRef(
+                        self.net, victim.process, tlog2.pop_stream.endpoint
+                    ),
+                )
+            testcov("dd.drain_retry")
+            cc.trace.trace("DDExcludeDrainRetry", Tag=tag)
+            return False
+        for view in cc.views:
+            cc._fill_view(view)
+        victim.stop()  # fully retired; its process is now removable
+        self.exclusion_drains += 1
+        testcov("dd.excluded_drained")
+        cc.trace.trace(
+            "DDExcludedDrained", Tag=tag, From=victim.process.name,
+            To=proc.name, StartVersion=start_v,
+        )
+        return True
+
+    # -- redundancy convergence (configure redundancy=..., online) -----------
+    async def converge_redundancy(self, policy) -> bool:
+        """One replica-change step toward the policy's replication factor;
+        True once every team matches.  The conf poll re-invokes each tick,
+        so a double->triple flip adds one replica per tick per shard until
+        converged — the online half of the reference's DatabaseConfiguration
+        redundancy change (DD team rebuild under the new policy)."""
+        cc = self.cc
+        target = policy.replicas()
+        if cc.generation is None or cc._recovering or self._moving:
+            return False
+        for i, team in enumerate(cc.storage_teams_tags):
+            if len(team) == target:
+                continue
+            self._moving = True
+            try:
+                if len(team) < target:
+                    await self._add_replica(i, policy)
+                else:
+                    await self._remove_replica(i)
+            finally:
+                self._moving = False
+            return False  # one step per tick; next poll continues
+        return True
+
+    async def _add_replica(self, shard: int, policy) -> bool:
+        """Grow one team: a fresh server takes a new tag, the proxies tag
+        mutations for it from a drained boundary, and it fetches history
+        from its teammates (startMoveKeys semantics for a team grow)."""
+        cc = self.cc
+        teams = [list(t) for t in cc.storage_teams_tags]
+        splits = list(cc.storage_splits)
+        bounds: list = [b""] + splits + [None]
+        team = teams[shard]
+        b, e = bounds[shard], bounds[shard + 1]
+        existing = {cc._parse_tag(t)[1] for t in team}
+        r = next(k for k in range(64) if k not in existing)
+        tag = f"ss-{shard}-r{r}"
+        members = [cc._tag_to_ss[t] for t in team]
+        self._heal_seq += 1
+        extra = {}
+        if cc.machines:
+            # policy-driven placement: the candidate must keep the grown
+            # team valid (ReplicationPolicy::validate, not just "different
+            # machine")
+            from ..rpc.policy import Locality
+
+            mlocs = [Locality.of(s.process) for s in members]
+            used = {l.machine for l in mlocs}
+            ring = cc._placement_ring()
+            pick = None
+            for idx in range(len(ring)):
+                m, d = ring[(self._heal_seq + idx) % len(ring)]
+                if m in used:
+                    continue
+                if policy.validate(mlocs + [Locality(f"cand-{m}", m, d)]):
+                    pick = (m, d)
+                    break
+            if pick is None:
+                pick = next((md for md in ring if md[0] not in used), None)
+            if pick is None:
+                cc.trace.trace("DDAddReplicaImpossible", Shard=shard, Tag=tag)
+                return False
+            extra = {"machine": pick[0], "dc": pick[1]}
+        proc = self.net.create_process(
+            f"storage-{shard}r{r}-g{self._heal_seq}", **extra
+        )
+        store = self.store_factory(tag, proc)
+        gen = cc.generation
+        tlog = gen.tlogs[cc._tag_tlogs(tag)[0]]
+        start_v = min(s.known_committed for s in members)
+        new_ss = StorageServer(
+            proc, self.loop, self.knobs,
+            tlog_peek_ref=RequestStreamRef(self.net, proc, tlog.peek_stream.endpoint),
+            tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
+            tag=tag, store=store, start_version=start_v,
+        )
+        cc._tag_to_ss[tag] = new_ss
+        cc.storage.append(new_ss)
+        new_teams = [list(t) for t in teams]
+        new_teams[shard] = team + [tag]
+        vm = await cc.install_storage_assignment(splits, new_teams)
+        if vm is None:
+            cc._tag_to_ss.pop(tag, None)
+            cc.storage.remove(new_ss)
+            new_ss.process.kill()
+            new_ss.stop()
+            return False
+        self._watch(new_ss)
+        refs = [
+            RequestStreamRef(self.net, proc, s.getkv_stream.endpoint)
+            for s in members
+        ]
+        fut = new_ss.start_fetch(b, e, vm, refs)
+        try:
+            await fut
+            # durable before the persisted map names the new replica (the
+            # move_range discipline: never persist a map pointing at data
+            # that exists only in memory)
+            vdone = new_ss.version.get()
+            for _ in range(600):
+                if new_ss.durable_version >= min(vdone, vm):
+                    break
+                await self.loop.delay(0.25, TaskPriority.COORDINATION)
+            else:
+                raise TimedOut("new replica durability never caught up")
+        except (TimedOut, BrokenPromise):
+            fut.cancel()
+            while True:
+                v2 = await cc.install_storage_assignment(splits, teams)
+                if v2 is not None:
+                    break
+                await self.loop.delay(0.1, TaskPriority.COORDINATION)
+            cc._tag_to_ss.pop(tag, None)
+            cc.storage.remove(new_ss)
+            old_pong = self._pong_tasks.pop(tag, None)
+            if old_pong is not None:
+                old_pong.cancel()
+            new_ss.process.kill()
+            new_ss.stop()
+            testcov("dd.add_replica_retry")
+            return False
+        await cc.persist_key_servers(splits, new_teams)
+        testcov("dd.replica_added")
+        cc.trace.trace(
+            "DDReplicaAdded", Shard=shard, Tag=tag, Machine=extra.get("machine"),
+            Boundary=vm,
+        )
+        return True
+
+    async def _remove_replica(self, shard: int) -> bool:
+        """Shrink one team: drop the highest-numbered replica at a drained
+        boundary, reclaim its TLog tag, retire the server."""
+        from ..roles.types import TLogPopRequest
+
+        cc = self.cc
+        teams = [list(t) for t in cc.storage_teams_tags]
+        splits = list(cc.storage_splits)
+        team = teams[shard]
+        if len(team) <= 1:
+            return False
+        drop = max(team, key=lambda t: cc._parse_tag(t)[1])
+        new_teams = [list(t) for t in teams]
+        new_teams[shard] = [t for t in team if t != drop]
+        vm = await cc.install_storage_assignment(splits, new_teams)
+        if vm is None:
+            return False
+        await cc.persist_key_servers(splits, new_teams)
+        ss = cc._tag_to_ss.pop(drop, None)
+        if ss in cc.storage:
+            cc.storage.remove(ss)
+        pong = self._pong_tasks.pop(drop, None)
+        if pong is not None:
+            pong.cancel()
+        # reclaim the tag's TLog space (otherwise re-seeded every recovery)
+        gen = cc.generation
+        ccp = cc._cc_proc()
+        if gen is not None:
+            for idx in cc._tag_tlogs(drop):
+                RequestStreamRef(
+                    self.net, ccp, gen.tlogs[idx].pop_stream.endpoint
+                ).send(TLogPopRequest(drop, vm + (1 << 40)))
+
+        async def late_stop() -> None:
+            # in-flight reads at pre-boundary versions drain first
+            await self.loop.delay(1.5, TaskPriority.COORDINATION)
+            if ss is not None:
+                ss.stop()
+
+        self._tasks.append(
+            self.loop.spawn(late_stop(), TaskPriority.COORDINATION, "dd-retire")
+        )
+        testcov("dd.replica_removed")
+        cc.trace.trace("DDReplicaRemoved", Shard=shard, Tag=drop, Boundary=vm)
+        return True
 
     # -- shard splitting -----------------------------------------------------
     def _write_rates(self, gen, n_segs: int) -> list[float]:

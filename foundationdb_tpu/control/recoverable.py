@@ -23,6 +23,8 @@ from .coordination import CoordinatedState, Coordinator
 
 
 class RecoverableCluster:
+    CLUSTER_FILE = "fdb.cluster"
+
     def __init__(
         self,
         seed: int = 0,
@@ -65,6 +67,13 @@ class RecoverableCluster:
                                 # the full stream once and re-serves it to
                                 # remote read replicas of every shard
                                 # (LogRouter.actor.cpp + remote tLogs)
+        redundancy: str | None = None,  # declarative mode ("single"/"double"/
+                                # "triple"/"three_datacenter"): sets the
+                                # replication factor AND the placement policy
+                                # teams are validated against (PolicyAcross,
+                                # fdbrpc/ReplicationPolicy.h:121).  None =
+                                # storage_replication with an across-machine
+                                # policy when a machine topology exists.
     ) -> None:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -116,6 +125,21 @@ class RecoverableCluster:
             m, d = self.machines[ClusterController.spread_slot(i, n, len(self.machines))]
             return {"machine": m, "dc": d}
 
+        # declarative redundancy: the mode names both the factor and the
+        # policy object every team must satisfy
+        from ..rpc.policy import PolicyAcross, PolicyOne, policy_for_redundancy
+
+        if redundancy is not None:
+            self.replication_policy = policy_for_redundancy(redundancy)
+            storage_replication = self.replication_policy.replicas()
+        elif self.machines:
+            self.replication_policy = (
+                PolicyAcross(storage_replication, "machine")
+                if storage_replication > 1 else PolicyOne()
+            )
+        else:
+            self.replication_policy = PolicyOne()
+
         by_dc: dict[str, list[str]] = {}
         for m, d in self.machines:
             by_dc.setdefault(d, []).append(m)
@@ -148,14 +172,35 @@ class RecoverableCluster:
         self._initial_storage_splits = splits(n_storage_shards)
         resolver_splits = splits(n_resolvers)
 
+        # cluster-file analog (fdbclient/MonitorLeader.actor.cpp fdb.cluster):
+        # the durable pointer to the CURRENT coordinator quorum.  A restart
+        # must find the quorum wherever a coordinators-change moved it, or
+        # recovery would read empty registers and silently boot fresh.
+        self._mach_spread = mach_spread
+        self._coord_quorum_gen = 0
+        coord_paths = [f"coord{i}.reg" for i in range(n_coordinators)]
+        if restart and self.fs is not None and self.fs.exists(self.CLUSTER_FILE):
+            import json as _json
+
+            from ..storage.diskqueue import DiskQueue
+
+            try:
+                records = DiskQueue(self.fs.open(self.CLUSTER_FILE, None)).recover()
+                doc = _json.loads(records[-1])
+                coord_paths = list(doc["paths"])
+                self._coord_quorum_gen = int(doc.get("gen", 0))
+            except Exception:  # noqa: BLE001 — torn write: default quorum
+                pass
         self.coordinators = [
             Coordinator(
                 self.net.create_process(
-                    f"coord-{i}", **mach_spread(i, n_coordinators)
+                    f"coord-q{self._coord_quorum_gen}-{i}"
+                    if self._coord_quorum_gen else f"coord-{i}",
+                    **mach_spread(i, len(coord_paths)),
                 ),
-                self.loop, fs=self.fs, path=f"coord{i}.reg",
+                self.loop, fs=self.fs, path=coord_paths[i],
             )
-            for i in range(n_coordinators)
+            for i in range(len(coord_paths))
         ]
 
         # storage servers persist across generations; each shard is served
@@ -200,6 +245,22 @@ class RecoverableCluster:
                         start_version=start_version,
                     )
                 )
+        if self.machines:
+            # the policy object VALIDATES what the placement formula built —
+            # the team builder must refuse same-failure-domain teams
+            # (ReplicationPolicy::validate over the team's LocalityData)
+            from ..rpc.policy import Locality
+
+            for i in range(n_storage_shards):
+                team = self.storage[
+                    i * storage_replication : (i + 1) * storage_replication
+                ]
+                locs = [Locality.of(ss.process) for ss in team]
+                if not self.replication_policy.validate(locs):
+                    raise ValueError(
+                        f"shard {i} team violates replication policy "
+                        f"{self.replication_policy!r}: {locs}"
+                    )
 
         cc_proc = self.net.create_process("cc-election")
         cstate = CoordinatedState(
@@ -222,6 +283,10 @@ class RecoverableCluster:
             machines=self.machines,
             expect_workers=n_workers > 0,
         )
+
+        self.controller.on_coordinators_change = self._change_coordinators
+        self.controller._coordinator_count = len(self.coordinators)
+        self.controller.replication_policy = self.replication_policy
 
         self.log_router = None
         self.remote_storage: list[StorageServer] = []
@@ -289,8 +354,62 @@ class RecoverableCluster:
             self.loop, self.net, self.knobs, self.controller,
             store_factory=_heal_store,
         )
+        # `configure redundancy=` flips replication online through data
+        # distribution (add/remove one replica per conf poll until converged)
+        self.controller.on_redundancy_change = self.dd.converge_redundancy
         if remote_region:
             self._make_remote_storage(n_storage_shards, make_store)
+
+    async def _change_coordinators(self, n: int) -> bool:
+        """Coordinator-set change (ManagementAPI changeQuorum via
+        `\\xff/conf/coordinators`; the reference's MovableCoordinatedState,
+        fdbserver/CoordinatedState.actor.cpp:461): read the current cstate,
+        write it into a FRESH register quorum, durably repoint the cluster
+        file, swap the controller's refs, retire the old set.  The old
+        quorum's registers stay on disk until the cluster file names the
+        new one — a crash mid-change recovers whichever quorum the file
+        points at, both of which hold the state."""
+        cc = self.controller
+        if len(self.coordinators) == n:
+            return True
+        state, _gen = await cc.cstate.read()
+        self._coord_quorum_gen += 1
+        g = self._coord_quorum_gen
+        paths = [f"coord{i}-q{g}.reg" for i in range(n)]
+        new_coords = [
+            Coordinator(
+                self.net.create_process(
+                    f"coord-q{g}-{i}", **self._mach_spread(i, n)
+                ),
+                self.loop, fs=self.fs, path=paths[i],
+            )
+            for i in range(n)
+        ]
+        proc = cc._cc_proc()
+        new_cstate = CoordinatedState(
+            self.loop,
+            [RequestStreamRef(self.net, proc, c.read_stream.endpoint) for c in new_coords],
+            [RequestStreamRef(self.net, proc, c.write_stream.endpoint) for c in new_coords],
+            owner="cc",
+        )
+        if state is not None and not await new_cstate.write(state):
+            for c in new_coords:
+                c.stop()
+            return False
+        if self.fs is not None:
+            import json as _json
+
+            from ..storage.diskqueue import DiskQueue
+
+            dq = DiskQueue(self.fs.open(self.CLUSTER_FILE, proc))
+            dq.rewrite([_json.dumps({"gen": g, "paths": paths}).encode()])
+            await dq.sync()
+        old = self.coordinators
+        self.coordinators = new_coords
+        cc.cstate = new_cstate
+        for c in old:
+            c.stop()
+        return True
 
     def _spawn_worker(self, idx: int, pclass: str, reg_ep):
         from ..roles.worker import Worker

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from .types import (
     CommitUnknownResult,
+    DatabaseLocked,
     FutureVersion,
     NotCommitted,
     TransactionTooOld,
@@ -25,6 +26,7 @@ ERROR_REGISTRY: dict[type, tuple[int, str]] = {
     FutureVersion: (1009, "future_version"),
     NotCommitted: (1020, "not_committed"),
     CommitUnknownResult: (1021, "commit_unknown_result"),
+    DatabaseLocked: (1038, "database_locked"),
     BrokenPromise: (1100, "broken_promise"),
     ActorCancelled: (1101, "operation_cancelled"),
 }

@@ -133,6 +133,9 @@ class CommitProxy:
         self.full_stream_tags: list[str] = []
         self.committed_version = NotifiedVersion(start_version)
         self.ratekeeper = None  # set by the cluster; None = unlimited
+        # database lock UID (`\xff/conf/lock`): non-lock-aware user commits
+        # are refused while set (ManagementAPI lock, error 1038)
+        self.locked: bytes | None = None
         self.name = process.name
         self.on_commit_failure = None  # controller hook: escalate to recovery
         self._req_num = 0
@@ -295,6 +298,22 @@ class CommitProxy:
 
     async def _commit_batch_inner(self, batch: list[_PendingCommit]) -> None:
         self.c_batches.add(1)
+        if self.locked is not None and batch:
+            # database lock (ManagementAPI lock/unlock; reference checks the
+            # lock key in commitBatch, error 1038): only lock-aware txns and
+            # system (`\xff`) writes — the unlock txn itself — pass
+            allowed: list[_PendingCommit] = []
+            for pc in batch:
+                t = pc.request
+                if t.lock_aware or (
+                    t.mutations
+                    and all(m.key.startswith(b"\xff") for m in t.mutations)
+                ):
+                    allowed.append(pc)
+                else:
+                    testcov("proxy.database_locked")
+                    pc.reply_cb.reply(CommitReply(CommitResult.DATABASE_LOCKED))
+            batch = allowed
         deadline = self.loop.now() + self.knobs.COMMIT_PATH_GIVEUP
         self._req_num += 1
         # sampled debug IDs only (usually none): the station loops below

@@ -319,13 +319,45 @@ class StorageServer:
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
         self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
         self._watches: dict[bytes, list] = {}  # key -> [(expected, req)]
+        self._dur_task = loop.spawn(
+            self._durability(), TaskPriority.STORAGE_SERVER, f"ss-dur-{tag}"
+        )
         self._tasks = [
             loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, f"ss-pull-{tag}"),
             loop.spawn(self._serve_getvalue(), TaskPriority.STORAGE_SERVER, f"ss-gv-{tag}"),
             loop.spawn(self._serve_getkv(), TaskPriority.STORAGE_SERVER, f"ss-gkv-{tag}"),
             loop.spawn(self._serve_watch(), TaskPriority.STORAGE_SERVER, f"ss-w-{tag}"),
-            loop.spawn(self._durability(), TaskPriority.STORAGE_SERVER, f"ss-dur-{tag}"),
+            self._dur_task,
         ]
+
+    def freeze_writes(self) -> None:
+        """Retiring-replica mode (the exclusion drain retires a LIVE
+        server): keep pulling and serving reads — the replacement fetches
+        its snapshot from here at any version — but never touch the store
+        file or the shared tag queue again.  The replacement recovers this
+        replica's store file and becomes the tag's only popper; its pops
+        trail its own durable version, so nothing this (ahead) replica
+        still needs is trimmed."""
+        if self.tlog_pop is not None:
+            self._saved_pop = self.tlog_pop
+            self.tlog_pop = None
+        if self._dur_task is not None:
+            self._dur_task.cancel()
+            self._dur_task = None
+
+    def unfreeze_writes(self) -> None:
+        """Undo freeze_writes (a failed exclusion drain rolls back; the
+        replacement's flushed WAL entries are valid same-tag data, so
+        resuming appends keeps the log consistent)."""
+        if self.tlog_pop is None and getattr(self, "_saved_pop", None) is not None:
+            self.tlog_pop = self._saved_pop
+            self._saved_pop = None
+        if self._dur_task is None:
+            self._dur_task = self.loop.spawn(
+                self._durability(), TaskPriority.STORAGE_SERVER,
+                f"ss-dur-{self.tag}",
+            )
+            self._tasks.append(self._dur_task)
 
     # -- write path: pull from TLog -----------------------------------------
     async def _pull(self) -> None:

@@ -100,6 +100,8 @@ class CommitTransactionRequest:
     write_conflict_ranges: list[tuple[bytes, bytes]]
     mutations: list[Mutation]
     debug_id: str | None = None  # sampled pipeline-timeline ID (g_traceBatch)
+    lock_aware: bool = False     # commit through a locked database
+                                 # (TransactionOption LOCK_AWARE)
 
 
 class CommitResult(enum.Enum):
@@ -109,6 +111,8 @@ class CommitResult(enum.Enum):
     UNKNOWN = "commit_unknown_result"        # pipeline failed mid-commit: the
                                              # txn may or may not have landed
                                              # (NativeAPI.actor.cpp:2482-2502)
+    DATABASE_LOCKED = "database_locked"      # locked by ManagementAPI and the
+                                             # txn is not lock-aware (1038)
 
 
 @dataclasses.dataclass
@@ -328,3 +332,9 @@ class CommitUnknownResult(Exception):
     """The commit may or may not have happened (proxy died / pipeline
     failover mid-commit).  Retrying is safe only for idempotent or
     self-verifying transactions — the same contract as the reference."""
+
+
+class DatabaseLocked(Exception):
+    """The database is locked (ManagementAPI lock/unlock) and this
+    transaction is neither lock-aware nor a system (`\\xff`) write —
+    reference error 1038 (fdbclient error_definitions.h)."""

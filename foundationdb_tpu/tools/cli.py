@@ -28,6 +28,12 @@ commands:
   watch <key>                 block until the key changes
   status [json]               cluster status summary (or full json)
   configure k=v ...           change role counts (n_tlogs/n_proxies/n_resolvers)
+  exclude <target> ...        drain + ban machines/processes (ManagementAPI)
+  include [target ...]        re-admit targets (none = all)
+  excluded                    list exclusions + whether removal is safe
+  lock | unlock <uid>         lock/unlock the database (error 1038 to others)
+  coordinators <n>            change the coordinator quorum size
+  maintenance <zone> <secs>   suppress healing for a zone while it bounces
   move <begin> <end> <shard>  MoveKeys: migrate a range to shard's team
   backup start <prefix>       continuous backup + snapshot into the cluster fs
   backup status | stop        backup progress / stop
@@ -126,6 +132,47 @@ class Cli:
                 await configure(self.db, **{k: int(v) for k, v in kw.items()})
             self._run(go())
             return f"configured {kw} (takes effect at next conf poll)"
+        if cmd == "exclude":
+            from ..client import management as mgmt
+
+            self._run(mgmt.exclude(self.db, list(args)))
+            return (
+                f"excluded {list(args)} (drain in progress; "
+                f"'excluded' reports when removal is safe)"
+            )
+        if cmd == "include":
+            from ..client import management as mgmt
+
+            self._run(mgmt.include(self.db, list(args) or None))
+            return f"included {list(args) or 'all'}"
+        if cmd == "excluded":
+            from ..client import management as mgmt
+
+            targets = self._run(mgmt.get_excluded(self.db))
+            if not targets:
+                return "no exclusions"
+            safe = mgmt.exclusion_safe(c, targets)
+            return f"excluded: {targets} — {'SAFE to remove' if safe else 'draining…'}"
+        if cmd == "lock":
+            from ..client import management as mgmt
+
+            uid = self._run(mgmt.lock_database(self.db))
+            return f"locked; uid {uid.decode()}"
+        if cmd == "unlock":
+            from ..client import management as mgmt
+
+            self._run(mgmt.unlock_database(self.db, _b(args[0])))
+            return "unlocked"
+        if cmd == "coordinators":
+            from ..client import management as mgmt
+
+            self._run(mgmt.set_coordinators(self.db, int(args[0])))
+            return f"coordinator change to {args[0]} requested"
+        if cmd == "maintenance":
+            from ..client import management as mgmt
+
+            self._run(mgmt.set_maintenance(self.db, args[0], float(args[1])))
+            return f"maintenance on {args[0]} for {args[1]}s"
         if cmd == "move":
             # move BEGIN END SHARD_IDX — MoveKeys through data distribution
             dest = c.controller.storage_teams_tags[int(args[2])]

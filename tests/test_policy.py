@@ -1,0 +1,131 @@
+"""Declarative replication policy (fdbrpc/ReplicationPolicy.h:101 PolicyOne,
+:121 PolicyAcross) + the online redundancy flip it drives."""
+
+import pytest
+
+from foundationdb_tpu.client import management as mgmt
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.rpc.policy import (
+    Locality,
+    PolicyAcross,
+    PolicyOne,
+    policy_for_redundancy,
+)
+
+
+def L(p, m=None, d=None):
+    return Locality(p, m, d)
+
+
+def test_policy_one():
+    p = PolicyOne()
+    assert p.replicas() == 1
+    assert p.validate([L("a", "m1")])
+    assert not p.validate([])
+    assert p.select([L("a"), L("b")]) == [0]
+    assert p.select([]) is None
+
+
+def test_policy_across_machines():
+    p = PolicyAcross(2, "machine")
+    assert p.replicas() == 2
+    assert p.validate([L("a", "m1"), L("b", "m2")])
+    # same machine twice: REFUSED — the team builder contract
+    assert not p.validate([L("a", "m1"), L("b", "m1")])
+    # selection picks one per machine, stable order
+    sel = p.select([L("a", "m1"), L("b", "m1"), L("c", "m2")])
+    assert sel == [0, 2]
+    assert p.select([L("a", "m1"), L("b", "m1")]) is None
+
+
+def test_policy_nested_across():
+    # two DCs, two machines each: the reference's composition
+    p = PolicyAcross(2, "dc", PolicyAcross(2, "machine"))
+    assert p.replicas() == 4
+    good = [
+        L("a", "m1", "dc0"), L("b", "m2", "dc0"),
+        L("c", "m3", "dc1"), L("d", "m4", "dc1"),
+    ]
+    assert p.validate(good)
+    bad = [
+        L("a", "m1", "dc0"), L("b", "m1", "dc0"),  # same machine in dc0
+        L("c", "m3", "dc1"), L("d", "m4", "dc1"),
+    ]
+    assert not p.validate(bad)
+    # unset locality values are distinct groups (reference semantics)
+    assert PolicyAcross(2, "machine").validate([L("a"), L("b")])
+
+
+def test_redundancy_modes():
+    assert policy_for_redundancy("double").replicas() == 2
+    assert policy_for_redundancy("triple").replicas() == 3
+    assert policy_for_redundancy("three_datacenter").attr == "dc"
+    with pytest.raises(ValueError):
+        policy_for_redundancy("quadruple-rainbow")
+
+
+def test_team_builder_refuses_policy_violation():
+    # 2 replicas cannot be placed across machines when only 1 machine exists
+    with pytest.raises(ValueError):
+        RecoverableCluster(
+            seed=520, n_machines=1, n_dcs=1, storage_replication=2,
+        )
+
+
+def test_redundancy_flip_online():
+    """configure(redundancy=...) flips double -> triple -> double with data
+    intact and teams policy-valid throughout (VERDICT r4 #4 acceptance)."""
+    c = RecoverableCluster(
+        seed=521, n_machines=6, n_dcs=2, n_storage_shards=2,
+        redundancy="double",
+    )
+    assert all(len(t) == 2 for t in c.controller.storage_teams_tags)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        for i in range(30):
+            tr.set(b"k%02d" % i, b"v%d" % i)
+        await tr.commit()
+
+        await mgmt.configure(db, redundancy="triple")
+        for _ in range(600):
+            await c.loop.delay(0.1)
+            if all(len(t) == 3 for t in c.controller.storage_teams_tags):
+                break
+        assert all(len(t) == 3 for t in c.controller.storage_teams_tags)
+
+        # policy-valid teams: three distinct machines per team
+        from foundationdb_tpu.rpc.policy import Locality
+
+        pol = policy_for_redundancy("triple")
+        for team in c.controller._storage_teams():
+            locs = [Locality.of(ss.process) for ss in team]
+            assert pol.validate(locs), locs
+
+        # data fully readable (replicas consistent is checked by reads
+        # hitting any replica through the view refresh)
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"k", b"l")
+        assert len(rows) == 30
+
+        # flip back down
+        await mgmt.configure(db, redundancy="double")
+        for _ in range(600):
+            await c.loop.delay(0.1)
+            if all(len(t) == 2 for t in c.controller.storage_teams_tags):
+                break
+        assert all(len(t) == 2 for t in c.controller.storage_teams_tags)
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"k", b"l")
+        assert len(rows) == 30
+
+        # writes still flow after both flips
+        async def w(tr):
+            tr.set(b"after", b"1")
+        await db.run(w)
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 600)
+    assert c.dd.exclusion_drains == 0
+    c.stop()
