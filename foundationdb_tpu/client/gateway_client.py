@@ -1,0 +1,200 @@
+"""Pure-Python client for the gateway wire protocol — the script-language
+binding that needs no compiled library (the slot of the reference's
+bindings/python/fdb/impl.py, speaking tools/gateway.py's protocol instead
+of linking fdb_c).
+
+    from foundationdb_tpu.client.gateway_client import GatewayClient, open_cluster
+
+    db = GatewayClient(host, port)                 # direct
+    db = open_cluster("/etc/fdbtpu/fdb.cluster")   # via coordinator discovery
+    with db.transaction() as tr:
+        tr[b"k"] = b"v"       # commit on clean exit; on_error+retry loop
+    print(db.read(lambda tr: tr[b"k"]))
+
+Blocking, one request in flight per client (the simple-binding contract);
+see bindings/python/fdbtpu_ctypes.py for the C-ABI twin.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+_LEN = struct.Struct("<I")
+_HDR = struct.Struct("<QB")
+
+RETRYABLE_CODES = {1, 2, 3, 4, 5}
+
+
+class GatewayError(Exception):
+    def __init__(self, code: int) -> None:
+        super().__init__(f"gateway error status {code}")
+        self.code = code
+
+
+def _wstr(out: bytearray, s: bytes) -> None:
+    out += struct.pack("<I", len(s))
+    out += s
+
+
+class Transaction:
+    def __init__(self, db: "GatewayClient", tid: int) -> None:
+        self._db = db
+        self._tid = tid
+
+    def _body(self, *parts) -> bytearray:
+        """bytes parts are length-prefixed strings; bytearray parts are RAW
+        fixed-width fields (the gateway reads ints without a length prefix)."""
+        out = bytearray(struct.pack("<Q", self._tid))
+        for p in parts:
+            if isinstance(p, bytearray):
+                out += p
+            else:
+                _wstr(out, p)
+        return out
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._db._call(4, self._body(key, value))
+
+    __setitem__ = set
+
+    def get(self, key: bytes) -> bytes | None:
+        body = self._db._call(6, self._body(key))
+        present = body[0]
+        (n,) = struct.unpack_from("<I", body, 1)
+        return bytes(body[5 : 5 + n]) if present else None
+
+    __getitem__ = get
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._db._call(5, self._body(begin, end))
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
+        body = self._db._call(
+            7, self._body(begin, end, bytearray(struct.pack("<I", limit)))
+        )
+        (n,) = struct.unpack_from("<I", body, 0)
+        off = 4
+        rows = []
+        for _ in range(n):
+            (kl,) = struct.unpack_from("<I", body, off)
+            off += 4
+            k = bytes(body[off : off + kl])
+            off += kl
+            (vl,) = struct.unpack_from("<I", body, off)
+            off += 4
+            rows.append((k, bytes(body[off : off + vl])))
+            off += vl
+        return rows
+
+    def atomic_add(self, key: bytes, delta: int) -> None:
+        self._db._call(
+            10, self._body(key, bytearray(struct.pack("<q", delta)))
+        )
+
+    def get_read_version(self) -> int:
+        body = self._db._call(11, self._body())
+        return struct.unpack_from("<q", body, 0)[0]
+
+    def commit(self) -> int:
+        body = self._db._call(8, self._body())
+        return struct.unpack_from("<q", body, 0)[0]
+
+    def on_error(self, code: int) -> None:
+        self._db._call(9, self._body(bytearray(struct.pack("<i", code))))
+
+    def reset(self) -> None:
+        self._db._call(3, self._body())
+
+    def destroy(self) -> None:
+        self._db._call(2, self._body())
+
+    # context manager: commit on clean exit, retry loop on retryable codes
+    def __enter__(self) -> "Transaction":
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        if et is None:
+            while True:
+                try:
+                    self.commit()
+                    break
+                except GatewayError as e:
+                    if e.code not in RETRYABLE_CODES:
+                        self.destroy()
+                        raise
+                    self.on_error(e.code)
+        self.destroy()
+        return False
+
+
+class GatewayClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._req = 0
+
+    def _call(self, op: int, body: bytes | bytearray = b"") -> bytes:
+        self._req += 1
+        payload = _HDR.pack(self._req, op) + bytes(body)
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        hdr = self._recv_exact(_LEN.size)
+        (flen,) = _LEN.unpack(hdr)
+        frame = self._recv_exact(flen)
+        req_id, status = _HDR.unpack_from(frame, 0)
+        if req_id != self._req:
+            raise GatewayError(255)
+        if status != 0:
+            raise GatewayError(status)
+        return frame[_HDR.size :]
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("gateway closed")
+            buf += chunk
+        return bytes(buf)
+
+    def protocol_version(self) -> int:
+        return struct.unpack_from("<I", self._call(12), 0)[0]
+
+    def transaction(self) -> Transaction:
+        body = self._call(1)
+        (tid,) = struct.unpack_from("<Q", body, 0)
+        return Transaction(self, tid)
+
+    def run(self, fn):
+        """Retry loop (the bindings' `run` contract)."""
+        while True:
+            tr = self.transaction()
+            try:
+                out = fn(tr)
+                tr.commit()
+                tr.destroy()
+                return out
+            except GatewayError as e:
+                if e.code not in RETRYABLE_CODES:
+                    tr.destroy()
+                    raise
+                tr.on_error(e.code)
+
+    def read(self, fn):
+        tr = self.transaction()
+        try:
+            return fn(tr)
+        finally:
+            tr.destroy()
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def open_cluster(cluster_file: str, timeout: float = 15.0) -> GatewayClient:
+    """Connect via the cluster file: discover the current gateway from the
+    coordinator quorum (MonitorLeader), then dial it."""
+    from .cluster_file import discover_gateway
+
+    host, port = discover_gateway(cluster_file, timeout=timeout)
+    return GatewayClient(host, port)

@@ -69,7 +69,11 @@ class Coordinator:
     WLT_WRITE = "wlt:coord_write"
 
     def __init__(self, process: SimProcess, loop: EventLoop,
-                 fs=None, path: str | None = None) -> None:
+                 fs=None, path: str | None = None,
+                 tokens: tuple[str, str] | None = None) -> None:
+        """`tokens` overrides the well-known stream tokens so one process
+        can host several registers (the reference's coordinators serve the
+        cstate AND the leader-election register from one server)."""
         self.process = process
         self.loop = loop
         self.value: Any = None
@@ -80,8 +84,9 @@ class Coordinator:
         if fs is not None:
             self._file = fs.open(path or f"coord-{process.name}.reg", process)
             self._load()
-        self.read_stream = RequestStream(process, self.WLT_READ)
-        self.write_stream = RequestStream(process, self.WLT_WRITE)
+        read_tok, write_tok = tokens or (self.WLT_READ, self.WLT_WRITE)
+        self.read_stream = RequestStream(process, read_tok)
+        self.write_stream = RequestStream(process, write_tok)
         self._tasks = [
             loop.spawn(self._serve_read(), TaskPriority.COORDINATION, "coord-read"),
             loop.spawn(self._serve_write(), TaskPriority.COORDINATION, "coord-write"),

@@ -74,8 +74,18 @@ class RecoverableCluster:
                                 # fdbrpc/ReplicationPolicy.h:121).  None =
                                 # storage_replication with an across-machine
                                 # policy when a machine topology exists.
+        loop: EventLoop | None = None,  # reuse an external loop (the multi-
+                                # OS-process server shares one loop between
+                                # the sim world and its RealNetwork)
+        external_cstate=None,   # CoordinatedState over REMOTE coordinator
+                                # processes (tools/coordserver.py) instead
+                                # of in-process Coordinator objects
+        wall_driver=None,       # drives bootstrap futures against the wall
+                                # clock WITH socket IO (rpc/transport.py
+                                # NetDriver) — required with external_cstate,
+                                # whose RPCs need the sockets pumped
     ) -> None:
-        self.loop = EventLoop()
+        self.loop = loop or EventLoop()
         self.rng = DeterministicRandom(seed)
         from ..runtime import buggify as _buggify
 
@@ -177,7 +187,10 @@ class RecoverableCluster:
         # must find the quorum wherever a coordinators-change moved it, or
         # recovery would read empty registers and silently boot fresh.
         self._mach_spread = mach_spread
+        self._wall_driver = wall_driver
         self._coord_quorum_gen = 0
+        if external_cstate is not None:
+            n_coordinators = 0  # the quorum lives in other OS processes
         coord_paths = [f"coord{i}.reg" for i in range(n_coordinators)]
         if restart and self.fs is not None and self.fs.exists(self.CLUSTER_FILE):
             import json as _json
@@ -262,13 +275,16 @@ class RecoverableCluster:
                         f"{self.replication_policy!r}: {locs}"
                     )
 
-        cc_proc = self.net.create_process("cc-election")
-        cstate = CoordinatedState(
-            self.loop,
-            [RequestStreamRef(self.net, cc_proc, c.read_stream.endpoint) for c in self.coordinators],
-            [RequestStreamRef(self.net, cc_proc, c.write_stream.endpoint) for c in self.coordinators],
-            owner="cc",
-        )
+        if external_cstate is not None:
+            cstate = external_cstate
+        else:
+            cc_proc = self.net.create_process("cc-election")
+            cstate = CoordinatedState(
+                self.loop,
+                [RequestStreamRef(self.net, cc_proc, c.read_stream.endpoint) for c in self.coordinators],
+                [RequestStreamRef(self.net, cc_proc, c.write_stream.endpoint) for c in self.coordinators],
+                owner="cc",
+            )
         self.controller = ClusterController(
             self.loop, self.net, self.knobs, self.rng, self.trace,
             storage=self.storage,
@@ -284,8 +300,11 @@ class RecoverableCluster:
             expect_workers=n_workers > 0,
         )
 
-        self.controller.on_coordinators_change = self._change_coordinators
-        self.controller._coordinator_count = len(self.coordinators)
+        if external_cstate is None:
+            # quorum moves only apply to in-process coordinators; a remote
+            # quorum (tools/coordserver.py) is operated out-of-band
+            self.controller.on_coordinators_change = self._change_coordinators
+            self.controller._coordinator_count = len(self.coordinators)
         self.controller.replication_policy = self.replication_policy
 
         self.log_router = None
@@ -313,7 +332,12 @@ class RecoverableCluster:
             self._monitor_task = self.loop.spawn(
                 self._fdbmonitor(reg_ep), 0, "fdbmonitor"
             )
-        self.loop.run_until(self.loop.spawn(self.controller.start()), 30.0)
+        boot = self.loop.spawn(self.controller.start())
+        if self._wall_driver is not None:
+            # remote-cstate RPCs need their sockets pumped during bootstrap
+            self._wall_driver.run_until(boot, wall_timeout=60.0)
+        else:
+            self.loop.run_until(boot, 30.0)
         from .ratekeeper import Ratekeeper
 
         self.ratekeeper = Ratekeeper(

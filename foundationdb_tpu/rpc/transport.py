@@ -406,29 +406,33 @@ class RealNetwork:
         self._sel.close()
 
 
-class NetDriver:
-    """Drives an EventLoop against the wall clock WITH socket IO — the Net2
-    reactor: each idle gap until the next timer is spent in select()."""
+class WallDriver:
+    """Drives an EventLoop against the wall clock WITH reactor IO — the
+    Net2 "reactor + run loop" shape.  `pumps` is one or more
+    `pump(timeout)` callables (RealNetwork.pump, ClientGateway.pump, ...);
+    each idle gap until the next timer is split across them.  THE single
+    wall-clock driver — tools/gateway.py's GatewayDriver is a thin alias."""
 
-    def __init__(self, loop: EventLoop, net: RealNetwork) -> None:
+    def __init__(self, loop: EventLoop, pumps: list[Callable[[float], None]]) -> None:
         self.loop = loop
-        self.net = net
+        self.pumps = list(pumps)
         self._origin = _time.monotonic() - loop.now()
 
     def _tick(self) -> None:
-        """One reactor turn: drain every due timer, poll the sockets for
-        the gap until the next one, and anchor virtual time to the wall
+        """One reactor turn: drain every due timer, spend the gap until the
+        next one polling the reactors, and anchor virtual time to the wall
         (run_one never moves time backwards, so the anchor is always safe —
         the single place this time model lives for the real-IO driver)."""
         now = _time.monotonic()
         while self.loop._heap and self._origin + self.loop._heap[0][0] <= now:
             self.loop.run_one()
             now = _time.monotonic()
+        gap = 0.02
         if self.loop._heap:
-            delta = (self._origin + self.loop._heap[0][0]) - now
-            self.net.pump(min(max(delta, 0.0), 0.02))
-        else:
-            self.net.pump(0.02)
+            gap = min(max((self._origin + self.loop._heap[0][0]) - now, 0.0), 0.02)
+        share = gap / max(len(self.pumps), 1)
+        for pump in self.pumps:
+            pump(share)
         self.loop._now = max(self.loop._now, _time.monotonic() - self._origin)
 
     def run_until(self, fut: Future, wall_timeout: float | None = None) -> Any:
@@ -444,3 +448,11 @@ class NetDriver:
         start = _time.monotonic()
         while wall_timeout is None or _time.monotonic() - start < wall_timeout:
             self._tick()
+
+
+class NetDriver(WallDriver):
+    """WallDriver over one RealNetwork (the common single-reactor case)."""
+
+    def __init__(self, loop: EventLoop, net: RealNetwork) -> None:
+        super().__init__(loop, [net.pump])
+        self.net = net

@@ -1,0 +1,56 @@
+"""Standalone coordinator — one OS process serving the cluster's generation
+registers over real TCP (the coordinator slot of `fdbserver` when its
+address is listed in the cluster file; fdbserver/Coordination.actor.cpp
+coordinationServer).
+
+    python -m foundationdb_tpu.tools.coordserver [--port P]
+
+Serves TWO registers, exactly like the reference's coordination server:
+
+  * the CLUSTER STATE register (recovery generations — CoordinatedState)
+    on the default `wlt:coord_read`/`wlt:coord_write` tokens, and
+  * the LEADER register (which server currently runs the cluster, and its
+    client-gateway address — the MonitorLeader discovery target) on
+    `wlt:leader_read`/`wlt:leader_write`.
+
+A quorum of these processes is the cluster's ground truth; servers
+(tools/server.py --cluster-file) write through them and clients
+(client/cluster_file.py) discover the gateway from them.  Registers are
+in-memory here — a killed coordinator rejoins empty and the quorum
+carries the state, which is the failure mode the test kills exercise.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+LEADER_TOKENS = ("wlt:leader_read", "wlt:leader_write")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--ip", default="127.0.0.1")
+    ap.add_argument("--run-seconds", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    from ..control.coordination import Coordinator
+    from ..rpc.transport import NetDriver, RealNetwork
+    from ..runtime.core import EventLoop
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="coordinator", ip=args.ip, port=args.port)
+    Coordinator(net.process, loop)  # cluster-state register
+    Coordinator(net.process, loop, tokens=LEADER_TOKENS)  # leader register
+    print(f"coordinator ready on {net.address.ip}:{net.address.port}", flush=True)
+    try:
+        NetDriver(loop, net).serve_forever(wall_timeout=args.run_seconds)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        net.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,14 +38,14 @@ from __future__ import annotations
 import selectors
 import socket
 import struct
-import time as _time
 
 from ..client.transaction import (
     CommitUnknownResult,
     NotCommitted,
 )
 from ..roles.types import FutureVersion, MutationType, TransactionTooOld
-from ..runtime.core import EventLoop, Future, TaskPriority, TimedOut
+from ..rpc.transport import WallDriver
+from ..runtime.core import EventLoop, TaskPriority, TimedOut
 
 _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<QB")  # req_id, op
@@ -280,37 +280,14 @@ class ClientGateway:
         self._lsock.close()
 
 
-class GatewayDriver:
-    """Wall-clock driver for a sim cluster + gateway: ticks due timers, then
-    spends the idle gap in the gateway's select() (the NetDriver shape,
-    rpc/transport.py:314)."""
+class GatewayDriver(WallDriver):
+    """Wall-clock driver for a sim cluster + gateway — a WallDriver over
+    the gateway's reactor, optionally sharing the idle gap with a second
+    `pump(timeout)` (the server's RealNetwork when remote coordinators are
+    in play)."""
 
-    def __init__(self, loop: EventLoop, gateway: ClientGateway) -> None:
-        self.loop = loop
+    def __init__(self, loop: EventLoop, gateway: ClientGateway,
+                 extra_pump=None) -> None:
+        pumps = [gateway.pump] + ([extra_pump] if extra_pump is not None else [])
+        super().__init__(loop, pumps)
         self.gw = gateway
-        self._origin = _time.monotonic() - loop.now()
-
-    def _tick(self) -> None:
-        now = _time.monotonic()
-        while self.loop._heap and self._origin + self.loop._heap[0][0] <= now:
-            self.loop.run_one()
-            now = _time.monotonic()
-        if self.loop._heap:
-            delta = (self._origin + self.loop._heap[0][0]) - now
-            self.gw.pump(min(max(delta, 0.0), 0.02))
-        else:
-            self.gw.pump(0.02)
-        self.loop._now = max(self.loop._now, _time.monotonic() - self._origin)
-
-    def serve_forever(self, wall_timeout: float | None = None) -> None:
-        start = _time.monotonic()
-        while wall_timeout is None or _time.monotonic() - start < wall_timeout:
-            self._tick()
-
-    def run_until(self, fut: Future, wall_timeout: float | None = None):
-        start = _time.monotonic()
-        while not fut.done():
-            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:
-                raise TimedOut(f"wall timeout {wall_timeout}s")
-            self._tick()
-        return fut.result()

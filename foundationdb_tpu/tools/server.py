@@ -30,6 +30,11 @@ def main(argv=None) -> None:
     ap.add_argument("--workers", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--cluster-file", default=None,
+                    help="fdb.cluster naming REMOTE coordinator processes "
+                         "(tools/coordserver.py); the recovery state lives "
+                         "on that quorum and the gateway address is "
+                         "published to it for client discovery")
     ap.add_argument("--run-seconds", type=float, default=None,
                     help="exit after N wall seconds (default: run forever)")
     args = ap.parse_args(argv)
@@ -38,6 +43,41 @@ def main(argv=None) -> None:
     from .gateway import ClientGateway, GatewayDriver
 
     sink = open(args.trace_file, "a") if args.trace_file else None
+    rnet = None
+    extra = {}
+    leader_cs = None
+    if args.cluster_file:
+        # multi-OS-process deployment: the cstate quorum is remote, reached
+        # over the real TCP fabric sharing the cluster's event loop
+        from ..client.cluster_file import (
+            cstate_refs,
+            leader_refs,
+            parse_cluster_file,
+        )
+        from ..control.coordination import CoordinatedState
+        from ..rpc.transport import NetDriver, RealNetwork
+        from ..runtime.core import EventLoop
+
+        loop = EventLoop()
+        rnet = RealNetwork(loop, name=f"server-{args.seed}")
+        _desc, coords = parse_cluster_file(args.cluster_file)
+        cstate = CoordinatedState(
+            loop,
+            cstate_refs(rnet, rnet.process, coords),
+            cstate_refs(rnet, rnet.process, coords, write=True),
+            owner=f"server-{rnet.address.port}",
+        )
+        leader_cs = CoordinatedState(
+            loop,
+            leader_refs(rnet, rnet.process, coords),
+            leader_refs(rnet, rnet.process, coords, write=True),
+            owner=f"server-{rnet.address.port}",
+        )
+        extra = dict(
+            loop=loop,
+            external_cstate=cstate,
+            wall_driver=NetDriver(loop, rnet),
+        )
     cluster = RecoverableCluster(
         seed=args.seed,
         n_storage_shards=args.shards,
@@ -45,18 +85,45 @@ def main(argv=None) -> None:
         storage_engine=args.engine,
         n_workers=args.workers,
         trace_sink=sink,
+        **extra,
     )
     gw = ClientGateway(cluster.loop, cluster.database(), port=args.port)
+    driver = GatewayDriver(
+        cluster.loop, gw,
+        extra_pump=rnet.pump if rnet is not None else None,
+    )
+    if leader_cs is not None:
+        # publish the gateway address for client discovery, and RE-ASSERT
+        # it periodically (MonitorLeader semantics): a conditional-write
+        # rejection (a client's read bumped the promised generation first)
+        # retries with a higher generation, and restarted in-memory
+        # coordinator registers re-learn the address within one period
+        async def publish_once() -> None:
+            for _ in range(50):
+                if await leader_cs.write({"gateway": f"127.0.0.1:{gw.port}"}):
+                    return
+            raise RuntimeError("could not publish gateway to coordinators")
+
+        async def reassert() -> None:
+            while True:
+                await cluster.loop.delay(2.0)
+                try:
+                    await publish_once()
+                except Exception:  # noqa: BLE001 — quorum down: next period
+                    pass
+
+        driver.run_until(cluster.loop.spawn(publish_once()), wall_timeout=30.0)
+        cluster.loop.spawn(reassert())
     print(f"fdbtpu server ready on 127.0.0.1:{gw.port}", flush=True)
     try:
-        GatewayDriver(cluster.loop, gw).serve_forever(
-            wall_timeout=args.run_seconds
-        )
+        driver.serve_forever(wall_timeout=args.run_seconds)
     except KeyboardInterrupt:
         pass
     finally:
         gw.close()
         cluster.stop()
+        if rnet is not None:
+            rnet.close()
         if sink:
             sink.close()
         print("fdbtpu server stopped", file=sys.stderr)

@@ -1,0 +1,142 @@
+"""Multi-OS-process cluster deployment: coordinators as separate OS
+processes over real TCP, a server process hosting the cluster, and a
+client connecting via the cluster-file bootstrap (MonitorLeader analog —
+fdbclient/MonitorLeader.actor.cpp:435; fdbserver coordinationServer).
+
+Five OS processes total: 3 coordinators + 1 server + this test as the
+client.  A coordinator is killed mid-run; the quorum of two carries on."""
+
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "PALLAS_AXON_POOL_IPS": "",  # skip the TPU-tunnel plugin: CPU-only procs
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+class Proc:
+    def __init__(self, *mod_args: str) -> None:
+        self.p = subprocess.Popen(
+            [sys.executable, "-m", *mod_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=ENV, cwd=REPO,
+        )
+        self.lines: queue.Queue[str] = queue.Queue()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self) -> None:
+        for line in self.p.stdout:
+            self.lines.put(line)
+
+    def wait_line(self, needle: str, timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                line = self.lines.get(timeout=0.5)
+            except queue.Empty:
+                if self.p.poll() is not None:
+                    raise RuntimeError(
+                        f"process exited rc={self.p.returncode} before {needle!r}"
+                    )
+                continue
+            if needle in line:
+                return line
+        raise TimeoutError(f"never saw {needle!r}")
+
+    def kill(self) -> None:
+        self.p.kill()
+        self.p.wait()
+
+
+def test_cluster_file_bootstrap_and_coordinator_kill(tmp_path):
+    from foundationdb_tpu.client.cluster_file import write_cluster_file
+    from foundationdb_tpu.client.gateway_client import open_cluster
+    from foundationdb_tpu.rpc.network import NetworkAddress
+
+    coords: list[Proc] = []
+    server: Proc | None = None
+    try:
+        addrs = []
+        for _ in range(3):
+            c = Proc("foundationdb_tpu.tools.coordserver", "--run-seconds", "240")
+            line = c.wait_line("coordinator ready on")
+            hostport = line.strip().rsplit(" ", 1)[1]
+            ip, _, port = hostport.rpartition(":")
+            addrs.append(NetworkAddress(ip, int(port)))
+            coords.append(c)
+
+        cf = str(tmp_path / "fdb.cluster")
+        write_cluster_file(cf, addrs)
+
+        server = Proc(
+            "foundationdb_tpu.tools.server",
+            "--cluster-file", cf,
+            "--shards", "1", "--replication", "1", "--workers", "0",
+            "--engine", "memory", "--run-seconds", "240",
+        )
+        server.wait_line("fdbtpu server ready on", timeout=120.0)
+
+        # client: coordinator discovery via the cluster file ONLY (no port
+        # was passed to this test code path)
+        db = open_cluster(cf, timeout=30.0)
+        assert db.protocol_version() >= 1
+
+        # Cycle: a ring of N pointers; each txn atomically advances one
+        # link — the ring-sum invariant must hold at every read
+        N = 5
+        with db.transaction() as tr:
+            for i in range(N):
+                tr.set(b"cyc%d" % i, b"%d" % ((i + 1) % N))
+
+        def cycle_step(k1: int, k2: int):
+            def fn(tr):
+                a = tr.get(b"cyc%d" % k1)
+                b = tr.get(b"cyc%d" % k2)
+                tr.set(b"cyc%d" % k1, b)
+                tr.set(b"cyc%d" % k2, a)
+            db.run(fn)
+
+        for i in range(6):
+            cycle_step(i % N, (i + 2) % N)
+
+        # kill one coordinator: quorum of 2/3 still stands, commits flow
+        coords[0].kill()
+        for i in range(6):
+            cycle_step((i + 1) % N, (i + 3) % N)
+
+        def check(tr):
+            vals = [tr.get(b"cyc%d" % i) for i in range(N)]
+            return sorted(int(v) for v in vals)
+
+        # the ring's values are a permutation of 0..N-1 throughout
+        assert db.read(check) == list(range(N))
+
+        # raw-field ops over the wire: atomic_add and a limited get_range
+        for _ in range(3):
+            db.run(lambda tr: tr.atomic_add(b"ctr", 2))
+        rows = db.read(lambda tr: tr.get_range(b"cyc", b"cyd", limit=3))
+        assert len(rows) == 3 and rows[0][0] == b"cyc0"
+        ctr = db.read(lambda tr: tr.get(b"ctr"))
+        assert int.from_bytes(ctr, "little", signed=True) == 6
+
+        # a FRESH client can still discover through the surviving quorum
+        db2 = open_cluster(cf, timeout=30.0)
+        assert db2.read(check) == list(range(N))
+        db2.close()
+        db.close()
+    finally:
+        for c in coords:
+            c.kill()
+        if server is not None:
+            server.kill()
