@@ -109,22 +109,20 @@ class Transaction:
     def destroy(self) -> None:
         self._db._call(2, self._body())
 
-    # context manager: commit on clean exit, retry loop on retryable codes
+    # context manager: commit on clean exit.  A retryable commit failure
+    # PROPAGATES — the block cannot be re-run from here, and on_error wipes
+    # the write set, so a retry loop would commit an empty transaction and
+    # silently drop the block's writes.  Use GatewayClient.run(fn) for the
+    # retry-loop contract.
     def __enter__(self) -> "Transaction":
         return self
 
     def __exit__(self, et, ev, tb) -> bool:
-        if et is None:
-            while True:
-                try:
-                    self.commit()
-                    break
-                except GatewayError as e:
-                    if e.code not in RETRYABLE_CODES:
-                        self.destroy()
-                        raise
-                    self.on_error(e.code)
-        self.destroy()
+        try:
+            if et is None:
+                self.commit()
+        finally:
+            self.destroy()
         return False
 
 
@@ -166,19 +164,22 @@ class GatewayClient:
         return Transaction(self, tid)
 
     def run(self, fn):
-        """Retry loop (the bindings' `run` contract)."""
-        while True:
-            tr = self.transaction()
-            try:
-                out = fn(tr)
-                tr.commit()
-                tr.destroy()
-                return out
-            except GatewayError as e:
-                if e.code not in RETRYABLE_CODES:
-                    tr.destroy()
-                    raise
-                tr.on_error(e.code)
+        """Retry loop (the bindings' `run` contract): ONE gateway-side
+        transaction reused across retries (on_error resets it), destroyed
+        on every exit path — no server-side object leaks."""
+        tr = self.transaction()
+        try:
+            while True:
+                try:
+                    out = fn(tr)
+                    tr.commit()
+                    return out
+                except GatewayError as e:
+                    if e.code not in RETRYABLE_CODES:
+                        raise
+                    tr.on_error(e.code)
+        finally:
+            tr.destroy()
 
     def read(self, fn):
         tr = self.transaction()

@@ -476,6 +476,21 @@ class Transaction:
         self._write_ranges.append((begin, end))
 
     def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
+        # versionstamped placeholders are validated HERE, at the API
+        # boundary: the proxy must never see a malformed offset it would
+        # have to fail mid-batch (it still guards, defense in depth)
+        if op == MutationType.SET_VERSIONSTAMPED_KEY:
+            from ..roles.types import VERSIONSTAMP_LEN
+
+            off = int.from_bytes(key[-4:], "little")
+            if len(key) < 14 or off + VERSIONSTAMP_LEN > len(key) - 4:
+                raise ValueError(f"versionstamp offset {off} out of range")
+        elif op == MutationType.SET_VERSIONSTAMPED_VALUE:
+            from ..roles.types import VERSIONSTAMP_LEN
+
+            off = int.from_bytes(operand[-4:], "little")
+            if len(operand) < 14 or off + VERSIONSTAMP_LEN > len(operand) - 4:
+                raise ValueError(f"versionstamp offset {off} out of range")
         self._mutations.append(Mutation(op, key, operand))
         self._write_ranges.append((key, key_after(key)))
 

@@ -40,8 +40,6 @@ from ..roles.tlog import TLog
 from ..roles.types import (
     ResolutionMetricsRequest,
     ResolutionSplitRequest,
-    TLogLockReply,
-    TLogLockRequest,
     TLogPopRequest,
     Version,
 )
@@ -52,6 +50,7 @@ from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TaskPr
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
 from ..runtime.coverage import testcov
+from .logsystem import LogSystem
 
 
 class RecoveryState:
@@ -73,6 +72,9 @@ class GenerationRoles:
     resolvers: list[Resolver]
     tlogs: list[TLog]
     processes: list[SimProcess]
+    # this epoch's durability plane as an object (LogSystem.h ILogSystem):
+    # recovery locks it, stream consumers wire through it
+    log_system: "LogSystem | None" = None
     ping_tasks: list = dataclasses.field(default_factory=list)
     # worker mode: the registry entries hosting this generation's roles
     # (roles are destroyed via DestroyGenerationRequest, not process kills —
@@ -362,138 +364,59 @@ class ClusterController:
         finally:
             self._recovering = False
 
+    def _keep_tag(self, tag: str) -> bool:
+        """Seed filter for the next epoch: a stream-consumer tag (backup
+        worker / log router / DR) is re-seeded only while its consumer is
+        registered — residue of a finished consumer is dropped, not carried
+        forever."""
+        if tag.startswith(("backup-", "router-", "dr-")):
+            return tag in self.stream_consumers
+        return True
+
     async def _lock_old_tlogs(self, old: GenerationRoles | None):
+        """Epoch end via the LogSystem abstraction: lock the old set (disk
+        fallback for observably-dead members), compute the recovery
+        version, and build the next epoch's seeds."""
         if old is None:
             return 0, [dict() for _ in range(self.n_tlogs)]
-        replies: list[TLogLockReply | None] = []
-        for i, t in enumerate(old.tlogs):
-            ref = RequestStreamRef(self.net, self._cc_proc(), t.lock_stream.endpoint)
-            try:
-                replies.append(await ref.get_reply(TLogLockRequest(), timeout=1.0))
-                continue
-            except (TimedOut, BrokenPromise):
-                pass
-            # a KILLED TLog's disk outlives it (kill drops only the unsynced
-            # suffix, and every acked commit was synced first): recover its
-            # state from the file — the difference between "machine died"
-            # and "data lost".  Only for observably-dead processes: an alive
-            # but partitioned TLog must not be bypassed (it could still be
-            # acking; the lock fence is what stops it).
-            if self.fs is not None and not t.process.alive:
-                path = (
-                    old.tlog_paths[i] if i < len(old.tlog_paths)
-                    else self._tlog_path(i, old.epoch)
-                )
-                reply = self._read_tlog_file(path)
-                if reply is not None:
-                    testcov("recovery.tlog_disk_fallback")
-                    replies.append(reply)
-                    continue
-            replies.append(None)  # that TLog is gone
-        alive = [r for r in replies if r is not None]
-        if not alive:
-            raise RuntimeError("all TLogs lost: unrecoverable data loss")
-        # a committed version was acked by EVERY TLog (the proxy waits on
-        # all of them before replying), so it is <= every survivor's end;
-        # min over survivors keeps all committed data and drops any torn
-        # partially-pushed suffix consistently across tags (the reference's
-        # recovery-version rule)
-        recovery_version = min(r.end_version for r in alive)
-        return recovery_version, self._merge_tlog_replies(alive, recovery_version)
-
-    def _merge_tlog_replies(
-        self, alive: list[TLogLockReply], recovery_version: Version
-    ) -> list[dict]:
-        """Rebuild per-new-tlog tag seeds from surviving replicas."""
-        from ..roles.backup import BACKUP_TAG
-        from ..roles.logrouter import ROUTER_TAG
-
-        merged: dict[str, list] = {}
-        for r in alive:
-            for tag, entries in r.tags.items():
-                if tag in (BACKUP_TAG, ROUTER_TAG) and tag not in self.stream_consumers:
-                    continue  # residue of a finished consumer: drop, not seed
-                cur = merged.setdefault(tag, [])
-                have = {v for v, _ in cur}
-                cur.extend((v, m) for v, m in entries if v not in have)
-        seeds = [dict() for _ in range(self.n_tlogs)]
-        for tag, entries in merged.items():
-            entries.sort(key=lambda e: e[0])
-            entries = [e for e in entries if e[0] <= recovery_version]
-            for idx in self._tag_tlogs(tag):
-                seeds[idx][tag] = list(entries)  # per-replica copy: the new
-                # TLogs append to these lists independently
-        return seeds
+        ls = old.log_system or LogSystem(old.epoch, old.tlogs, old.tlog_paths)
+        recovery_version, replies = await ls.lock(
+            self.net, self._cc_proc(), self.fs,
+            required_tags=[s.tag for s in self.storage] if self.fs is not None else [],
+        )
+        seeds = LogSystem.merge_replies(
+            replies, recovery_version, self.n_tlogs, self._keep_tag
+        )
+        return recovery_version, seeds
 
     def _tlog_path(self, slot: int, epoch: int) -> str:
         return f"tlog{slot}-e{epoch}.dq"
 
-    def _read_tlog_file(self, path: str) -> TLogLockReply | None:
-        """Recover one TLog's state from its synced log file (shared by the
-        whole-cluster restart path and the live-recovery fallback for
-        observably-dead TLogs)."""
-        if not self.fs.exists(path):
-            return None
-        from ..storage.diskqueue import DiskQueue
-
-        dq = DiskQueue(self.fs.open(path, None))
-        end, _kc, tags = TLog.recover_state(dq)
-        return TLogLockReply(end_version=end, tags=tags)
-
     def _recover_tlogs_from_disk(self, prev_epoch: int, prev_n_tlogs: int,
                                  prev_paths: list[str] | None = None):
-        """Whole-cluster restart: rebuild (recovery_version, seeds) from the
-        previous epoch's synced TLog files.  Unsynced suffixes died with the
-        power loss; every acked commit was synced on EVERY replica, so the
-        min over recovered ends keeps all acked data.
-
-        Enumerates the PREVIOUS epoch's slot count (recorded in the cstate
-        write), not the new config's — restarting with fewer TLog slots must
-        still replay every old slot's file or tags whose replica pair lived
-        in the dropped slots would be silently lost."""
-        paths = prev_paths or [
-            self._tlog_path(i, prev_epoch) for i in range(prev_n_tlogs)
-        ]
-        replies = [self._read_tlog_file(p) for p in paths]
-        alive = [r for r in replies if r is not None]
-        if not alive:
-            raise RuntimeError("no TLog files recovered: data loss")
-        if len(alive) < prev_n_tlogs:
-            # with 2x tag replication a missing slot is survivable only if
-            # every tag's OLD replica pair still has one surviving file —
-            # two missing slots that formed a pair mean silent loss of that
-            # pair's tags, which must be an error, not a quiet proceed
-            for s in self.storage:
-                pair = self._tag_tlogs(s.tag, prev_n_tlogs)
-                if all(replies[i] is None for i in pair):
-                    raise RuntimeError(
-                        f"tag {s.tag}: all replica slots {pair} lost — data loss"
-                    )
-        recovery_version = min(r.end_version for r in alive)
-        return recovery_version, self._merge_tlog_replies(alive, recovery_version)
+        """Whole-cluster restart through LogSystem.from_disk: the PREVIOUS
+        epoch's slot count (recorded in the cstate write) governs which
+        files are replayed — restarting with fewer TLog slots must still
+        replay every old slot's file."""
+        recovery_version, replies, _ls = LogSystem.from_disk(
+            self.fs, prev_epoch, prev_n_tlogs, prev_paths,
+            required_tags=[s.tag for s in self.storage],
+        )
+        seeds = LogSystem.merge_replies(
+            replies, recovery_version, self.n_tlogs, self._keep_tag
+        )
+        return recovery_version, seeds
 
     @staticmethod
     def _parse_tag(tag: str) -> tuple[int, int]:
-        """Storage tag -> (shard, replica).  Tags are per storage SERVER
-        (the reference's Tag(locality, id): each team member gets its own
-        tag and the proxy tags mutations with the whole team): "ss-3-r1" is
-        shard 3's replica 1; legacy "ss-3" is replica 0."""
-        parts = tag.split("-")
-        shard = int(parts[1])
-        replica = int(parts[2][1:]) if len(parts) > 2 else 0
-        return shard, replica
+        """Storage tag -> (shard, replica) — LogSystem.parse_tag delegate
+        (kept as the controller-facing name its call sites use)."""
+        return LogSystem.parse_tag(tag)
 
     def _tag_tlogs(self, tag: str, n_tlogs: int | None = None) -> list[int]:
-        """TLog replica set for a tag: primary + next (2x log replication —
-        the reference replicates each mutation to a TLog team under policy;
-        one TLog loss keeps every tag recoverable).  Pass `n_tlogs` to
-        compute a PREVIOUS epoch's replica map during disk recovery."""
-        n = self.n_tlogs if n_tlogs is None else n_tlogs
-        shard, replica = self._parse_tag(tag)
-        primary = (shard + replica) % n
-        if n == 1:
-            return [0]
-        return [primary, (primary + 1) % n]
+        """TLog replica slots for a tag — LogSystem.tag_slots delegate.
+        Pass `n_tlogs` to compute a PREVIOUS epoch's replica map."""
+        return LogSystem.tag_slots(tag, self.n_tlogs if n_tlogs is None else n_tlogs)
 
     def _initial_teams_from_tags(self) -> list[list[str]]:
         """Bootstrap the keyServers map from the tag naming convention
@@ -597,14 +520,10 @@ class ClusterController:
 
     def _wire_stream_consumer(self, gen: GenerationRoles, tag: str) -> None:
         w = self.stream_consumers[tag]
-        slots = self._tag_tlogs(tag)
-        tlog = gen.tlogs[slots[0]]
+        ls = gen.log_system
         w.set_tlog_source(
-            RequestStreamRef(self.net, w.process, tlog.peek_stream.endpoint),
-            [
-                RequestStreamRef(self.net, w.process, gen.tlogs[s].pop_stream.endpoint)
-                for s in slots
-            ],
+            ls.peek_ref(self.net, w.process, tag),
+            ls.pop_refs(self.net, w.process, tag),
         )
 
     # backward-compatible backup entry points (client/backup.py)
@@ -788,8 +707,11 @@ class ClusterController:
 
     async def _recruit(self, recovery_version: Version, tlog_seeds: list[dict]) -> GenerationRoles:
         if self.expect_workers:
-            return await self._recruit_via_workers(recovery_version, tlog_seeds)
-        return self._recruit_direct(recovery_version, tlog_seeds)
+            gen = await self._recruit_via_workers(recovery_version, tlog_seeds)
+        else:
+            gen = self._recruit_direct(recovery_version, tlog_seeds)
+        gen.log_system = LogSystem(gen.epoch, gen.tlogs, gen.tlog_paths)
+        return gen
 
     async def _recruit_via_workers(
         self, recovery_version: Version, tlog_seeds: list[dict]
@@ -1017,18 +939,18 @@ class ClusterController:
             ]
         return GenerationRoles(
             self.epoch, sequencer, proxies, resolvers, tlogs, procs,
-            ping_tasks, tlog_paths=tlog_paths,
+            ping_tasks=ping_tasks, tlog_paths=tlog_paths,
         )
 
     def _rewire(self, gen: GenerationRoles, recovery_version: Version | None = None) -> None:
         """Point storage servers and every registered client view at the new
         generation (the MonitorLeader push), rolling storage back past the
         recovery version (phantom versions of UNKNOWN txns must evaporate)."""
+        ls = gen.log_system
         for ss in self.storage:
-            tlog = gen.tlogs[self._tag_tlogs(ss.tag)[0]]
             ss.set_tlog_source(
-                RequestStreamRef(self.net, ss.process, tlog.peek_stream.endpoint),
-                RequestStreamRef(self.net, ss.process, tlog.pop_stream.endpoint),
+                ls.peek_ref(self.net, ss.process, ss.tag),
+                ls.pop_ref(self.net, ss.process, ss.tag),
                 recovery_version=recovery_version,
             )
         for tag in self.stream_consumers:

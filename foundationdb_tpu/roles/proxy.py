@@ -401,10 +401,31 @@ class CommitProxy:
         # phase 4: tag committed mutations, push to TLogs
         await maybe_delay(self.loop, "proxy.delay_tlog_push")
         by_tag: dict[str, list[Mutation]] = {}
-        for pc, v in zip(batch, verdicts):
+        txn_order = 0
+        for ti, (pc, v) in enumerate(zip(batch, verdicts)):
             if v != Verdict.COMMITTED:
                 continue
-            for m in pc.request.mutations:
+            muts = pc.request.mutations
+            if any(
+                m.type in (MutationType.SET_VERSIONSTAMPED_KEY,
+                           MutationType.SET_VERSIONSTAMPED_VALUE)
+                for m in muts
+            ):
+                # stamp substitution BEFORE key routing: the final key (not
+                # the placeholder) decides the shard.  A malformed offset
+                # (client-controlled input) fails ONLY this transaction —
+                # never the batch, which would cascade into a recovery loop.
+                # (Phase 5 sends its NOT_COMMITTED reply.)
+                from .types import resolve_versionstamp
+
+                try:
+                    muts = [resolve_versionstamp(m, version, txn_order) for m in muts]
+                except ValueError:
+                    testcov("proxy.bad_versionstamp")
+                    verdicts[ti] = Verdict.CONFLICT
+                    continue
+            txn_order += 1
+            for m in muts:
                 nb = len(m.key) + len(m.value or b"")
                 if m.type == MutationType.CLEAR_RANGE:
                     teams = self.tags.members_for_range(m.key, m.value)

@@ -43,6 +43,40 @@ class Mutation:
     value: bytes         # for CLEAR_RANGE: range end
 
 
+VERSIONSTAMP_LEN = 10  # 8-byte big-endian version + 2-byte batch order
+
+
+def make_versionstamp(version: Version, txn_order: int) -> bytes:
+    """The 10-byte commit versionstamp (fdbclient/CommitTransaction.h:
+    8 bytes big-endian commit version + 2 bytes big-endian in-batch txn
+    order — big-endian so versionstamped keys sort in commit order)."""
+    return version.to_bytes(8, "big") + (txn_order & 0xFFFF).to_bytes(2, "big")
+
+
+def resolve_versionstamp(m: "Mutation", version: Version, txn_order: int) -> "Mutation":
+    """Substitute the commit versionstamp into a SET_VERSIONSTAMPED_KEY /
+    _VALUE mutation (done by the proxy at commit time — only it knows the
+    version; fdbserver/MasterProxyServer.actor.cpp applyMetadataMutations'
+    stamp substitution).  The operand's trailing 4 bytes are the
+    little-endian offset of the 10-byte placeholder (API >= 520 format)."""
+    stamp = make_versionstamp(version, txn_order)
+    if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+        off = int.from_bytes(m.key[-4:], "little")
+        raw = m.key[:-4]
+        if off + VERSIONSTAMP_LEN > len(raw):
+            raise ValueError(f"versionstamp offset {off} out of range")
+        key = raw[:off] + stamp + raw[off + VERSIONSTAMP_LEN:]
+        return Mutation(MutationType.SET_VALUE, key, m.value)
+    if m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+        off = int.from_bytes(m.value[-4:], "little")
+        raw = m.value[:-4]
+        if off + VERSIONSTAMP_LEN > len(raw):
+            raise ValueError(f"versionstamp offset {off} out of range")
+        val = raw[:off] + stamp + raw[off + VERSIONSTAMP_LEN:]
+        return Mutation(MutationType.SET_VALUE, m.key, val)
+    return m
+
+
 def apply_atomic(op: MutationType, old: bytes | None, operand: bytes) -> bytes:
     """Atomic-op math (fdbclient/Atomic.h semantics: operands zero-extended
     to a common length; ADD wraps little-endian)."""

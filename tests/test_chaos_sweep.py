@@ -8,6 +8,8 @@ Every cluster here runs with chaos=True: knob randomization
 peeks, early batch fires — on top of process attrition.
 """
 
+import os
+
 import pytest
 
 from foundationdb_tpu.control.recoverable import RecoverableCluster
@@ -16,7 +18,11 @@ from foundationdb_tpu.workloads.attrition import AttritionWorkload
 from foundationdb_tpu.workloads.base import run_workloads
 from foundationdb_tpu.workloads.cycle import CycleWorkload
 
-SWEEP_SEEDS = [1001, 1002, 1003, 1004, 1005]
+# seed matrix: FDBTPU_SOAK_SEEDS=N scales the sweep (CI default 5; a
+# nightly-style campaign runs FDBTPU_SOAK_SEEDS=50 — the reference's
+# methodology is thousands of random seeds, tester.actor.cpp rerun loop)
+_N_SEEDS = int(os.environ.get("FDBTPU_SOAK_SEEDS", "5"))
+SWEEP_SEEDS = [1000 + i for i in range(1, _N_SEEDS + 1)]
 
 
 @pytest.fixture(autouse=True)

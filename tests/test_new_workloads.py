@@ -1,0 +1,112 @@
+"""Round-5 invariant workloads: Serializability (versionstamped journal,
+serial-replay equivalence), FuzzApiCorrectness (randomized API sequences),
+and the restarting pair (save state, new process, resume — the
+tests/restarting/ CycleTestRestart-1/-2 shape)."""
+
+import pytest
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.runtime import buggify
+from foundationdb_tpu.workloads.attrition import AttritionWorkload
+from foundationdb_tpu.workloads.base import run_workloads
+from foundationdb_tpu.workloads.cycle import CycleWorkload
+from foundationdb_tpu.workloads.fuzzapi import FuzzApiWorkload
+from foundationdb_tpu.workloads.serializability import SerializabilityWorkload
+
+
+@pytest.fixture(autouse=True)
+def _buggify_off():
+    yield
+    buggify.disable()
+
+
+def test_versionstamped_key_substitution():
+    """SET_VERSIONSTAMPED_KEY: the proxy splices (commit version, batch
+    order) into the placeholder, keys sort in commit order."""
+    from foundationdb_tpu.roles.types import MutationType
+
+    c = RecoverableCluster(seed=540)
+    db = c.database()
+
+    async def main():
+        versions = []
+        for i in range(3):
+            tr = db.create_transaction()
+            key = b"vs/" + b"\x00" * 10 + (3).to_bytes(4, "little")
+            tr.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key, b"p%d" % i)
+            versions.append(await tr.commit())
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"vs/", b"vs0", limit=100)
+        return versions, rows
+
+    versions, rows = c.run_until(c.loop.spawn(main()), 120)
+    assert [v for _k, v in rows] == [b"p0", b"p1", b"p2"]  # commit order
+    for (k, _v), ver in zip(rows, versions):
+        assert int.from_bytes(k[3:11], "big") == ver  # stamped version
+    c.stop()
+
+
+def test_serializability_plain():
+    c = RecoverableCluster(seed=541, n_storage_shards=2)
+    metrics = run_workloads(
+        c, [SerializabilityWorkload(clients=3, txns_per_client=12)],
+        deadline=600.0,
+    )
+    assert metrics["Serializability"]["committed"] >= 30
+    c.stop()
+
+
+def test_serializability_under_chaos():
+    """The serial-replay equivalence must hold through kills + buggify —
+    this is the workload's whole point."""
+    c = RecoverableCluster(seed=542, n_storage_shards=2, chaos=True)
+    ser = SerializabilityWorkload(clients=2, txns_per_client=8)
+    att = AttritionWorkload(kills=1, interval=2.0, start_delay=0.8)
+    metrics = run_workloads(c, [ser, att], deadline=900.0)
+    assert metrics["Serializability"]["committed"] >= 10
+    assert c.controller.recoveries >= 1
+    c.stop()
+
+
+def test_fuzz_api_correctness():
+    c = RecoverableCluster(seed=543)
+    metrics = run_workloads(
+        c, [FuzzApiWorkload(clients=3, ops_per_client=150)], deadline=600.0
+    )
+    assert metrics["FuzzApi"]["ops"] == 450
+    c.stop()
+
+
+def test_fuzz_api_under_chaos():
+    c = RecoverableCluster(seed=544, chaos=True)
+    fz = FuzzApiWorkload(clients=2, ops_per_client=80)
+    att = AttritionWorkload(kills=1, interval=1.5, start_delay=0.6)
+    metrics = run_workloads(c, [fz, att], deadline=900.0)
+    assert metrics["FuzzApi"]["ops"] == 160
+    c.stop()
+
+
+def test_restarting_pair_cycle():
+    """The tests/restarting/ shape: part 1 runs Cycle and powers off
+    mid-state; part 2 resumes from the same disks (a NEW cluster object —
+    the 'new binary' of an upgrade test) and the ring invariant still
+    holds, then more rotations run."""
+    c1 = RecoverableCluster(seed=545, n_storage_shards=2)
+    cyc1 = CycleWorkload(nodes=8, clients=2, txns_per_client=6)
+    metrics1 = run_workloads(c1, [cyc1], deadline=600.0)
+    assert metrics1["Cycle"]["committed"] == 12
+    fs = c1.power_off()
+
+    c2 = RecoverableCluster(seed=546, fs=fs, restart=True)
+    # part 2's check: the ring survived the restart...
+    cyc2 = CycleWorkload(nodes=8, clients=2, txns_per_client=6)
+    cyc2.skip_setup = True
+
+    async def no_setup(cluster, rng):
+        return None
+
+    cyc2.setup = no_setup  # the ring already exists on disk
+    metrics2 = run_workloads(c2, [cyc2], deadline=600.0)
+    # ...and more rotations committed on the restarted cluster
+    assert metrics2["Cycle"]["committed"] == 12
+    c2.stop()
