@@ -329,12 +329,13 @@ def main() -> None:
 
 
 # Best-known configuration on TPU, committed so the default timed path needs
-# no exploratory compiles at all (VERDICT r4 #1a).  Measured on the real chip:
-# the LSM state confines the per-batch merge to the recent level, the sort
-# twins avoid TPU's serialized scatter/gather lowerings.  Override with
-# FDBTPU_SEARCH_IMPL / FDBTPU_MERGE_IMPL / FDBTPU_LSM, or set BENCH_AUTOTUNE=1
-# to re-measure all combos on the live device.
-BEST_KNOWN = ("sort", "sort", True)
+# no exploratory compiles at all (VERDICT r4 #1a): the LSM state confines the
+# per-batch merge to the recent level, the bucketed search amortizes batched
+# row gathers (r3/r4 measurements), the sort merge avoids TPU's serialized
+# scatter lowering.  Override with FDBTPU_SEARCH_IMPL / FDBTPU_MERGE_IMPL /
+# FDBTPU_LSM, or set BENCH_AUTOTUNE=1 to re-measure all combos on the live
+# device (the gather merge may beat sort — untimed on real hardware yet).
+BEST_KNOWN = ("bucket", "sort", True)
 
 
 def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
@@ -368,14 +369,18 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
     # (search_impl, merge_impl, lsm): lsm=True pays a rare O(CAP) compaction
     # instead of a per-batch full-state merge — the merge phase dominates on
     # TPU (52.8 of ~57ms/batch measured in r4), so it usually wins there.
-    # Best-known-first: a time-boxed autotune (flaky tunnel insurance) that
-    # stops early still lands on a good configuration.
+    # "gather" is the scatter-free/full-sort-free merge (positions from the
+    # ONE search's ranks; batched row gathers).  Best-known-first: a
+    # time-boxed autotune (flaky tunnel insurance) that stops early still
+    # lands on a good configuration.
     combos = [
+        ("bucket", "gather", True),
         ("bucket", "sort", True),
-        ("bucket", "scatter", True),
+        ("sort", "gather", True),
+        ("bucket", "gather", False),
         ("bucket", "sort", False),
+        ("bucket", "scatter", True),
         ("sort", "sort", False),
-        ("bucket", "scatter", False),
     ]
     budget_s = float(os.environ.get("BENCH_AUTOTUNE_BUDGET_S", "900"))
     t_start = time.perf_counter()

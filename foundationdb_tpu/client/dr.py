@@ -50,6 +50,11 @@ class DRWorker:
         self.tag = DR_TAG
         self.tlog = None
         self.tlog_pops: list = []
+        # paused until the initial snapshot lands AND the clip is set: an
+        # apply racing ahead of a chunk write would be clobbered by the
+        # chunk's older data (the TLog retains the tag while paused — no
+        # pops — so nothing is lost, only deferred)
+        self._paused = True
         self._fetched = start_version
         from ..roles.sequencer import NotifiedVersion
 
@@ -67,8 +72,12 @@ class DRWorker:
         self.tlog_pops = pop_refs
 
     def set_snapshot_clip(self, bounds: list[bytes], cvers: list[int]) -> None:
+        """Install the chunk-version step function and START applying —
+        only ever called after the last chunk write is committed on the
+        secondary, so no apply can race a chunk."""
         self._bounds = bounds
         self._cvers = cvers
+        self._paused = False
 
     def _chunk_version_at(self, key: bytes) -> int:
         i = bisect.bisect_right(self._bounds, key) - 1
@@ -115,7 +124,7 @@ class DRWorker:
 
     async def _pull(self) -> None:
         while True:
-            if self.tlog is None:
+            if self.tlog is None or self._paused:
                 await self.loop.delay(0.05, TaskPriority.STORAGE_SERVER)
                 continue
             try:
@@ -240,9 +249,20 @@ class DRAgent:
         pri_db = self.primary.database()
         await mgmt.lock_database(pri_db, b"dr-failover")
         # arm the primary's proxies immediately (the conf poll would too,
-        # one interval later) — no new user commits once drained
-        gen = self.primary.controller.generation
+        # one interval later) — no new user commits once drained.  Mid-
+        # recovery (generation None) wait for the new generation: the
+        # recovery-end lock application reads self._locked anyway.
         self.primary.controller._locked = b"dr-failover"
+        deadline = self.loop.now() + timeout
+        while True:
+            gen = self.primary.controller.generation
+            if gen is not None and not self.primary.controller._recovering:
+                break
+            if self.loop.now() >= deadline:
+                from ..runtime.core import TimedOut
+
+                raise TimedOut("primary never re-formed a generation")
+            await self.loop.delay(0.1, TaskPriority.COORDINATION)
         for p in gen.proxies:
             p.locked = b"dr-failover"
         tr = pri_db.create_transaction()

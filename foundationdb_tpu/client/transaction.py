@@ -107,6 +107,11 @@ class QueueModel:
         self._clock = clock
         # endpoint key -> [smoothed_latency, inflight, penalty_until, last_t]
         self._stats: dict = {}
+        # cluster-wide FailureMonitor (rpc/failmon.py), wired by Database
+        # when the view carries one: pick() skips replicas the cluster
+        # already knows are down instead of paying a timeout to rediscover
+        # it (LoadBalance.actor.h consulting IFailureMonitor::getState)
+        self.failmon = None
 
     def _key(self, ref) -> tuple:
         ep = ref.endpoint
@@ -134,6 +139,13 @@ class QueueModel:
         return lat * (1 + inflight) + p
 
     def pick(self, rng, members: list, opkey: str):
+        if self.failmon is not None and len(members) > 1:
+            live = [
+                m for m in members
+                if not self.failmon.is_failed(m[opkey].endpoint.address)
+            ]
+            if live:  # all-failed: fall through and probe anyway
+                members = live
         if len(members) == 1:
             return members[0][opkey]
         i = rng.random_int(0, len(members))
@@ -178,6 +190,7 @@ class Database:
         self.knobs = client_knobs or ClientKnobs()
         self._rng = rng.split()
         self._qm = QueueModel(loop.now)
+        self._qm.failmon = getattr(view, "failure_monitor", None)
         # fraction of transactions given a pipeline-timeline debug ID
         # (g_traceBatch; the reference samples via CLIENT_KNOBS->
         # *_DEBUG_TRANSACTION_RATE)

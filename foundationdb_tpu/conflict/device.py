@@ -83,7 +83,7 @@ BUCKET_BITS = 16
 N_BUCKETS = 1 << BUCKET_BITS
 FAST_SEARCH_ITERS = 11  # converges windows up to 1024 boundaries (2**(n-1))
 
-_IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort")}
+_IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort", "gather")}
 
 
 def impl_from_env(kind: str, override: str | None = None) -> str:
@@ -340,7 +340,7 @@ def resolve_core(
 
     # ---- phase 3: merge committed writes into the step function ---------
     w_ins = w_ok & committed[w_idx]
-    merge = phase_merge if merge_impl == "scatter" else phase_merge_sort
+    merge = _MERGE_IMPLS[merge_impl]
     new_ks, new_vs, new_count = merge(
         ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, cap=cap
     )
@@ -466,6 +466,121 @@ def phase_merge_sort(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap
     return new_ks, new_vs, new_count
 
 
+def _union_sorted(ks, vs, wb, we, wb_rank, we_rank, w_ins, *, cap: int):
+    """Element-domain union of the committed writes, produced SORTED with a
+    single 2Wn-row sort and ZERO scatters (the scatter-free twin of
+    _canonical_union, for TPU where scatters serialize per row).
+
+    Instead of canonical unique slots, every endpoint is its own element:
+    one sort (key words + a begins-before-ends tiebreak) orders them, a
+    coverage cumsum finds the 0<->+ transitions, and those transition
+    elements ARE the canonical boundaries (duplicates and interior
+    endpoints get no marks; equal-key end+begin pairs cancel through,
+    exactly the canonical union's net-delta-zero behavior).
+
+    Returns (u_rows sorted, u_rank, is_beg, news_mask, resume_val)."""
+    Wn, W = wb.shape
+    live = jnp.concatenate([w_ins, w_ins])
+    rows = jnp.concatenate([wb, we], axis=0)
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
+    # non-inserted rows to the sentinel region: they must not interleave
+    # with live equal keys (their delta is 0 but order could split a group)
+    rows = jnp.where(live[:, None], rows, sent_row[None, :])
+    tie = jnp.concatenate(
+        [jnp.zeros(Wn, jnp.uint32), jnp.ones(Wn, jnp.uint32)]
+    )
+    ranks = jnp.concatenate([wb_rank, we_rank])
+    delta = jnp.where(
+        live, jnp.concatenate([jnp.ones(Wn, jnp.int32), jnp.full(Wn, -1, jnp.int32)]), 0
+    )
+    ops = tuple(rows[:, w] for w in range(W)) + (tie, ranks, delta)
+    srt = jax.lax.sort(ops, num_keys=W + 1)
+    u_rows = jnp.stack(srt[:W], axis=1)
+    u_rank = srt[W + 1]
+    sdelta = srt[W + 2]
+    cov = jnp.cumsum(sdelta)
+    prev = jnp.concatenate([jnp.zeros(1, jnp.int32), cov[:-1]])
+    is_beg = (cov > 0) & (prev <= 0)
+    news_mask = is_beg | ((cov <= 0) & (prev > 0))
+    # resume value at an end boundary: the pre-state value AT that key
+    ks_at = jnp.take(ks, jnp.clip(u_rank, 0, cap - 1), axis=0)
+    key_exists = jnp.all(ks_at == u_rows, axis=1)
+    resume_idx = jnp.clip(jnp.where(key_exists, u_rank, u_rank - 1), 0, cap - 1)
+    resume_val = jnp.take(vs, resume_idx)
+    return u_rows, u_rank, is_beg, news_mask, resume_val
+
+
+def phase_merge_gather(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
+    """Gather-formulated insert — no full-state sort (the "sort" twin's
+    cost) and no M-sized row scatters (the "scatter" twin's poison): the
+    merge positions are already implied by the ONE search's ranks, so the
+    output is CONSTRUCTED by gathers:
+
+      pos_new[j] = rank + j     (strictly increasing: news in key order)
+      nb[p]      = #news at positions <= p   (one scalar-sort searchsorted)
+      out[p]     = is_new ? news[nb-1] : state[p - nb]
+
+    Everything M-sized is a 1-D int32 array or a batched row gather; the
+    only row SORT is the 2Wn-element union.  Coalesce/compaction reuses
+    the same trick: a stable 1-bit scalar sort yields the kept-row
+    permutation, and two cap-row gathers build the final state."""
+    Wn, W = wb.shape
+    n = 2 * Wn
+    u_rows, u_rank, is_beg, news_mask, resume_val = _union_sorted(
+        ks, vs, wb, we, wb_rank, we_rank, w_ins, cap=cap
+    )
+    M = cap + n
+    j = jnp.cumsum(news_mask.astype(jnp.int32)) - 1
+    # beyond-capacity news (rank == cap) are dropped, not clamped — same
+    # contract as phase_merge; they can only sit at the end of key order
+    pos_new = jnp.where(news_mask & (u_rank < cap), u_rank + j, M).astype(jnp.int32)
+    # news payloads in news order: pos_new is unique below M, so one
+    # single-key sort aligns (pos, is_beg, val, source row) by position
+    val_new = jnp.where(is_beg, commit_off, resume_val).astype(jnp.int32)
+    sp = jax.lax.sort(
+        (pos_new, is_beg.astype(jnp.int32), val_new,
+         jnp.arange(n, dtype=jnp.int32)),
+        num_keys=1,
+    )
+    s_beg, s_val, s_src = sp[1], sp[2], sp[3]
+    nb = jnp.searchsorted(
+        sp[0], jnp.arange(M, dtype=jnp.int32), side="right", method="sort"
+    ).astype(jnp.int32)
+    prev_nb = jnp.concatenate([jnp.zeros(1, jnp.int32), nb[:-1]])
+    is_new = nb > prev_nb
+    new_src = jnp.clip(nb - 1, 0, n - 1)
+    old_idx = jnp.clip(jnp.arange(M, dtype=jnp.int32) - nb, 0, cap - 1)
+
+    g_beg = jnp.take(s_beg, new_src)
+    g_val = jnp.take(s_val, new_src)
+    g_row = jnp.take(s_src, new_src)          # union row index of the news
+    delta_m = jnp.where(is_new, jnp.where(g_beg == 1, 1, -1), 0)
+    mcov = jnp.cumsum(delta_m) > 0
+    old_val = jnp.take(vs, old_idx)
+    old_sent = jnp.take(ks[:, -1], old_idx) == _SENT_WORD
+    sent = ~is_new & old_sent
+    val = jnp.where(is_new, g_val, jnp.where(mcov, commit_off, old_val))
+
+    keep = ~sent & jnp.concatenate([jnp.array([True]), val[1:] != val[:-1]])
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    kperm = jax.lax.sort(
+        ((~keep).astype(jnp.uint32), jnp.arange(M, dtype=jnp.int32)),
+        num_keys=1, is_stable=True,
+    )[1][:cap]
+    k_isnew = jnp.take(is_new, kperm)
+    out_old = jnp.take(ks, jnp.take(old_idx, kperm), axis=0)
+    out_new = jnp.take(u_rows, jnp.take(g_row, kperm), axis=0)
+    q_live = jnp.arange(cap) < new_count
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
+    new_ks = jnp.where(
+        q_live[:, None],
+        jnp.where(k_isnew[:, None], out_new, out_old),
+        sent_row[None, :],
+    )
+    new_vs = jnp.where(q_live, jnp.take(val, kperm), 0)
+    return new_ks, new_vs, new_count
+
+
 def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int):
     """Insert committed writes into the step function (replaces
     mergeWriteConflictRanges :1260): canonicalize the committed writes'
@@ -522,6 +637,12 @@ def phase_merge(ks, vs, wb, we, wb_rank, we_rank, w_ins, commit_off, *, cap: int
     new_vs = jnp.zeros(cap, jnp.int32).at[pos].set(val, mode="drop")
     return new_ks, new_vs, new_count
 
+
+_MERGE_IMPLS = {
+    "scatter": phase_merge,
+    "sort": phase_merge_sort,
+    "gather": phase_merge_gather,
+}
 
 _resolve_kernel = functools.partial(
     jax.jit,
@@ -630,7 +751,7 @@ def resolve_core_lsm(
 
     # ---- merge committed writes into RECENT -----------------------------
     w_ins = w_ok & committed[w_idx]
-    merge = phase_merge if merge_impl == "scatter" else phase_merge_sort
+    merge = _MERGE_IMPLS[merge_impl]
     new_rec_ks, new_rec_vs, new_rec_count = merge(
         rec_ks, rec_vs, wb, we, wb_rank, we_rank, w_ins, commit_off,
         cap=rec_cap,

@@ -178,6 +178,12 @@ class ClusterController:
         self.maintenance_zones: dict[str, float] = {}  # zone -> deadline
         self.replication_policy = None      # installed by the cluster assembly
         self.on_redundancy_change = None    # async (policy) -> bool (one step)
+        # cluster-wide liveness map (fdbrpc/FailureMonitor.h:65): fed by the
+        # heartbeats below + data distribution's storage pings, consulted by
+        # client load-balancing through every view
+        from ..rpc.failmon import FailureMonitor
+
+        self.failure_monitor = FailureMonitor(loop.now)
         self.ratekeeper = None  # set by the cluster after construction
         self.generation: GenerationRoles | None = None
         # full-stream consumers: tag -> worker (backup, log routers)
@@ -316,6 +322,12 @@ class ClusterController:
                 else:
                     for p in old.processes:
                         p.kill()  # old roles may not serve a split-brain
+                for p in old.processes:
+                    # retired addresses leave the liveness map, or it grows
+                    # with every recovery and stale failed entries linger
+                    # (a surviving worker process is re-added by the next
+                    # heartbeat that pings it)
+                    self.failure_monitor.forget(p.address)
                 for t in old.ping_tasks:
                     t.cancel()
                 # cancel the deposed roles' tasks too: a killed process stops
@@ -990,6 +1002,7 @@ class ClusterController:
                 ],
             )
         view.epoch = self.epoch
+        view.failure_monitor = self.failure_monitor
 
     def make_view(self, client_proc: SimProcess) -> ClusterView:
         view = ClusterView(None, None, None)
@@ -1311,7 +1324,9 @@ class ClusterController:
                 ref = RequestStreamRef(self.net, cc, Endpoint(p.address, "wlt:ping"))
                 try:
                     await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
+                    self.failure_monitor.set_status(p.address, False)
                 except (TimedOut, BrokenPromise):
+                    self.failure_monitor.set_status(p.address, True)
                     dead.append(p.name)
             if dead and self.generation is gen:
                 self.trace.trace(

@@ -107,7 +107,9 @@ class DataDistributor:
                 )
                 try:
                     await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)
+                    cc.failure_monitor.set_status(ss.process.address, False)
                 except (TimedOut, BrokenPromise):
+                    cc.failure_monitor.set_status(ss.process.address, True)
                     if self._in_maintenance(ss):
                         # fdbcli `maintenance`: the zone's processes are being
                         # deliberately bounced — healing would churn data
@@ -221,6 +223,7 @@ class DataDistributor:
             return
         for view in cc.views:
             cc._fill_view(view)
+        cc.failure_monitor.forget(dead.process.address)
         self.heals += 1
         testcov("dd.healed")
         cc.trace.trace(
@@ -355,6 +358,7 @@ class DataDistributor:
         for view in cc.views:
             cc._fill_view(view)
         victim.stop()  # fully retired; its process is now removable
+        cc.failure_monitor.forget(victim.process.address)
         self.exclusion_drains += 1
         testcov("dd.excluded_drained")
         cc.trace.trace(
@@ -531,6 +535,7 @@ class DataDistributor:
             await self.loop.delay(1.5, TaskPriority.COORDINATION)
             if ss is not None:
                 ss.stop()
+                cc.failure_monitor.forget(ss.process.address)
 
         self._tasks.append(
             self.loop.spawn(late_stop(), TaskPriority.COORDINATION, "dd-retire")

@@ -199,6 +199,51 @@ def main() -> None:
     print(f"  LSM effective/batch    {lsm_ms + comp_ms / batches_per_compact:9.1f} ms",
           flush=True)
 
+    # ---- merge-impl shootout (the dominant phase, isolated) --------------
+    # sort vs gather vs scatter at the RECENT-level shape (the per-batch
+    # cost in LSM mode) and at full CAP (the non-LSM per-batch cost)
+    print("\nmerge-impl shootout:", flush=True)
+    r_ok = rtv >= 0
+    w_ok = (wtv >= 0) & ~D._is_sentinel(wbv)
+    for label, cap_m, ks_m, vs_m, cnt_m in (
+        (f"recent 2^{B.REC_CAP.bit_length() - 1}", ldev._rec_cap,
+         ldev._rec_ks, ldev._rec_vs, ldev._rec_dev_count),
+        (f"main   2^{B.CAP.bit_length() - 1}", dev._cap,
+         dev._ks, dev._vs, dev._dev_count),
+    ):
+        # ranks from the sort search (exact at any depth)
+        @jax.jit
+        def ranks_of(ks_, cnt_):
+            _gl, _gh, wbr, wer, _c = D.phase_search_sort(
+                ks_, cnt_, rbv, rev, wbv, wev, r_ok, w_ok
+            )
+            return wbr, wer
+
+        wbr, wer = ranks_of(ks_m, cnt_m)
+        for impl in ("sort", "gather", "scatter"):
+            fn = D._MERGE_IMPLS[impl]
+            jfn = functools.partial(jax.jit, static_argnames=("cap",))(fn)
+
+            def probe(ks_, vs_, wbr_, wer_):
+                nk, nv, nc = jfn(
+                    ks_, vs_, wbv, wev, wbr_, wer_, w_ok,
+                    jnp.int32(1000), cap=cap_m,
+                )
+                return nc + nv[0] + nk[0, 0]
+
+            pj = jax.jit(probe)
+            try:
+                fetch(pj(ks_m, vs_m, wbr, wer))  # compile
+                ts = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    fetch(pj(ks_m, vs_m, wbr, wer))
+                    ts.append(time.perf_counter() - t0)
+                ms = sorted(ts)[2] * 1e3 - rtt
+                print(f"  {label} merge={impl:<8s} {ms:9.1f} ms", flush=True)
+            except Exception as e:  # noqa: BLE001 — report and keep going
+                print(f"  {label} merge={impl:<8s} FAILED: {e!r}", flush=True)
+
 
 if __name__ == "__main__":
     main()
