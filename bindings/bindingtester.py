@@ -1,0 +1,123 @@
+"""Binding conformance tester — the stack-machine spec every binding must
+execute identically (reference bindings/bindingtester/bindingtester.py +
+spec/: a seed-driven op stream interpreted by each language binding, with
+the resulting stacks and observations diffed byte-for-byte).
+
+`gen_ops(seed, n)` produces a randomized op stream over a small adversarial
+keyspace; `StackMachine(driver).run(ops)` interprets it against any object
+implementing the driver surface:
+
+    new_txn() -> txn;  txn.set/get/clear_range/get_range/atomic_add/
+    commit/reset
+
+and returns a DIGEST — the observation log plus the final stack.  Two
+bindings conform iff their digests for the same seed are equal.  Commit
+versions are never recorded raw (different clusters assign different
+versions); only data observations are.
+
+Drivers for the three shipped bindings live in tests/test_bindingtester.py:
+the C ABI (ctypes -> libfdbtpu_c.so -> gateway), the pure-Python gateway
+client, and the in-process client."""
+
+from __future__ import annotations
+
+import random
+
+NOT_PRESENT = b"RESULT_NOT_PRESENT"
+
+
+def gen_ops(seed: int, n: int = 120) -> list[tuple]:
+    """Seed-driven op stream (the spec generator).  Keys live under bt/
+    with adversarial shapes: empty suffixes, embedded NULs, shared
+    prefixes, near-boundary bytes."""
+    rng = random.Random(seed)
+
+    def key() -> bytes:
+        kind = rng.randrange(5)
+        if kind == 0:
+            return b"bt/"
+        if kind == 1:
+            return b"bt/\x00" + bytes([rng.randrange(4)])
+        if kind == 2:
+            return b"bt/" + bytes(rng.randrange(3) for _ in range(rng.randrange(1, 6)))
+        if kind == 3:
+            return b"bt/p" * rng.randrange(1, 4)
+        return b"bt/\xfe" + bytes([rng.randrange(256)])
+
+    ops: list[tuple] = []
+    for _ in range(n):
+        k = rng.randrange(12)
+        if k < 2:
+            ops.append(("PUSH", key()))
+        elif k == 2:
+            ops.append(("DUP",))  # empty-stack DUP is a no-op in the machine
+        elif k == 3:
+            ops.append(("SWAP",))
+        elif k == 4:
+            ops.append(("SET", key(), bytes(rng.randrange(5) for _ in range(rng.randrange(0, 9)))))
+        elif k == 5:
+            ops.append(("GET", key()))
+        elif k == 6:
+            ops.append(("CLEAR_RANGE", *sorted((key(), key()))))
+        elif k == 7:
+            ops.append(("GET_RANGE", *sorted((key(), key())), rng.randrange(1, 20)))
+        elif k == 8:
+            ops.append(("ATOMIC_ADD", key(), rng.randrange(-50, 50)))
+        elif k == 9:
+            ops.append(("GET_STACK_TOP",))
+        elif k == 10:
+            ops.append(("COMMIT",))
+        else:
+            ops.append(("RESET",))
+    ops.append(("COMMIT",))
+    ops.append(("GET_RANGE", b"bt/", b"bt0", 1000))  # final full scan
+    return ops
+
+
+class StackMachine:
+    def __init__(self, driver) -> None:
+        self.driver = driver
+        self.stack: list[bytes] = []
+        self.log: list = []
+
+    def run(self, ops: list[tuple]) -> list:
+        tr = self.driver.new_txn()
+        for op in ops:
+            kind = op[0]
+            if kind == "PUSH":
+                self.stack.append(op[1])
+            elif kind == "DUP":
+                if self.stack:
+                    self.stack.append(self.stack[-1])
+            elif kind == "SWAP":
+                if len(self.stack) >= 2:
+                    self.stack[-1], self.stack[-2] = self.stack[-2], self.stack[-1]
+            elif kind == "SET":
+                tr.set(op[1], op[2])
+            elif kind == "GET":
+                v = tr.get(op[1])
+                self.stack.append(v if v is not None else NOT_PRESENT)
+            elif kind == "CLEAR_RANGE":
+                tr.clear_range(op[1], op[2])
+            elif kind == "GET_RANGE":
+                rows = tr.get_range(op[1], op[2], op[3])
+                packed = b";".join(k + b"=" + v for k, v in rows)
+                self.stack.append(packed)
+                self.log.append(("range", op[1], op[2], op[3], packed))
+            elif kind == "ATOMIC_ADD":
+                tr.atomic_add(op[1], op[2])
+            elif kind == "GET_STACK_TOP":
+                self.log.append(("top", self.stack[-1] if self.stack else b"EMPTY"))
+            elif kind == "COMMIT":
+                tr.commit()
+                tr = self.driver.new_txn()
+            elif kind == "RESET":
+                tr.reset()
+            else:
+                raise ValueError(f"unknown op {kind!r}")
+        tr.commit()
+        return self.log + [("stack", list(self.stack))]
+
+
+def digest(driver, seed: int, n: int = 120) -> list:
+    return StackMachine(driver).run(gen_ops(seed, n))

@@ -107,6 +107,9 @@ class _Txn:
         if rc != 0:
             raise FdbTpuError(rc)
 
+    def reset(self) -> None:
+        self._db._check(self._db._lib.fdbtpu_txn_reset(self._db._h, self._tid))
+
     def destroy(self) -> None:
         self._db._lib.fdbtpu_txn_destroy(self._db._h, self._tid)
 

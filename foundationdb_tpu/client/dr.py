@@ -199,30 +199,38 @@ class DRAgent:
         self.start_version = vm
 
         # initial snapshot: chunked copy primary -> secondary (each chunk
-        # at its own read version; the stream covers everything above)
-        pri_db = self.primary.database()
-        cursor = b""
-        bounds: list[bytes] = []
-        cvers: list[int] = []
-        while True:
-            tr = pri_db.create_transaction()
-            rows = await tr.get_range(cursor, b"\xff", limit=chunk_rows,
-                                      snapshot=True)
-            v = await tr.get_read_version()
-            end = key_after(rows[-1][0]) if len(rows) == chunk_rows else b"\xff"
-            bounds.append(cursor)
-            cvers.append(v)
+        # at its own read version; the stream covers everything above).
+        # Any failure here must UNWIND the registration: a permanently
+        # paused worker retains the DR tag on the primary's TLogs forever
+        # (no pops while paused — the retention that makes the pause safe
+        # becomes a leak if the stream never starts).
+        try:
+            pri_db = self.primary.database()
+            cursor = b""
+            bounds: list[bytes] = []
+            cvers: list[int] = []
+            while True:
+                tr = pri_db.create_transaction()
+                rows = await tr.get_range(cursor, b"\xff", limit=chunk_rows,
+                                          snapshot=True)
+                v = await tr.get_read_version()
+                end = key_after(rows[-1][0]) if len(rows) == chunk_rows else b"\xff"
+                bounds.append(cursor)
+                cvers.append(v)
 
-            async def fn(tr2, rows=rows, cursor=cursor, end=end) -> None:
-                tr2.set_option(b"lock_aware")
-                tr2.clear_range(cursor, end)
-                for k, val in rows:
-                    tr2.set(k, val)
+                async def fn(tr2, rows=rows, cursor=cursor, end=end) -> None:
+                    tr2.set_option(b"lock_aware")
+                    tr2.clear_range(cursor, end)
+                    for k, val in rows:
+                        tr2.set(k, val)
 
-            await sec_db.run(fn)
-            if len(rows) < chunk_rows:
-                break
-            cursor = end
+                await sec_db.run(fn)
+                if len(rows) < chunk_rows:
+                    break
+                cursor = end
+        except BaseException:
+            await self.stop(unlock_secondary=True)
+            raise
         w.set_snapshot_clip(bounds, cvers)
         testcov("dr.started")
         return vm

@@ -314,7 +314,12 @@ class StorageServer:
         # and per-range read floors (a moved-in range is only readable at or
         # above its snapshot version)
         self._fetching: list[_FetchState] = []
-        self._range_floor: list[tuple[bytes, bytes, Version]] = []
+        # per-range read floors (a moved-in range is readable only at or
+        # above its snapshot version) as a coalescing range map — the
+        # KeyRangeMap structure the reference keeps such metadata in
+        from ..utils.rangemap import KeyRangeMap
+
+        self._range_floor = KeyRangeMap(default=0)
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
         self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
@@ -508,7 +513,7 @@ class StorageServer:
             if version > snap_v:
                 self.overlay.apply(version, m, self.store.get)
         self._fetching.remove(fs)
-        self._range_floor.append((fs.begin, fs.end_key, snap_v))
+        self._range_floor.merge(fs.begin, fs.end_key, snap_v, max)
         # watches parked while the range was in flight (plus any registered
         # before a move-in) are evaluated against the now-real data; a
         # synthetic range "touch" reuses the normal fire logic
@@ -582,17 +587,13 @@ class StorageServer:
         end_k = TOP_KEY if end is None else end
         self.store.clear_range(begin, end_k)
         self.overlay.purge_range(begin, end_k)
-        self._range_floor = [
-            (b, e, v) for b, e, v in self._range_floor
-            if not (begin <= b and e <= end_k)
-        ]
+        self._range_floor.assign(begin, end_k, 0)  # no longer served here
 
     def _floor_violation(self, begin: bytes, end: bytes, version: Version) -> bool:
         """True if any overlapping moved-in range has floor > version (its
         pre-snapshot history lives only on the old team)."""
         return any(
-            v > version and b < end and begin < e
-            for b, e, v in self._range_floor
+            v > version for _b, _e, v in self._range_floor.ranges(begin, end)
         )
 
     async def _durability(self) -> None:

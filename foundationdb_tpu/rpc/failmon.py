@@ -51,7 +51,10 @@ class FailureMonitor:
         return self._status.get(address)
 
     def failed_addresses(self) -> list:
-        return [a for a in self._status if self.is_failed(a)]
+        return [
+            a for a in self._status.keys() | self._override.keys()
+            if self.is_failed(a)
+        ]
 
     # -- simulation hook -----------------------------------------------------
     def set_override(self, address, failed: bool | None) -> None:

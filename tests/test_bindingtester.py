@@ -1,0 +1,188 @@
+"""Cross-binding stack-machine conformance (reference bindings/
+bindingtester/bindingtester.py): the same seed-driven op spec executed by
+all three shipped bindings — C ABI (ctypes -> libfdbtpu_c.so -> gateway),
+the pure-Python gateway client, and the in-process client — must produce
+byte-identical digests."""
+
+from __future__ import annotations
+
+import pathlib
+import select
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CDIR = REPO / "bindings" / "c"
+sys.path.insert(0, str(REPO / "bindings"))
+
+from bindingtester import digest  # noqa: E402
+
+SEEDS = [11, 12, 13]
+
+GATEWAY_SERVER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    from foundationdb_tpu.control.recoverable import RecoverableCluster
+    from foundationdb_tpu.tools.gateway import ClientGateway, GatewayDriver
+
+    c = RecoverableCluster(seed={seed}, n_storage_shards=2,
+                           storage_replication=2)
+    gw = ClientGateway(c.loop, c.database(), port=0)
+    print(gw.port, flush=True)
+    GatewayDriver(c.loop, gw).serve_forever(wall_timeout=120.0)
+    """
+)
+
+
+def _spawn_gateway(seed: int):
+    errf = tempfile.TemporaryFile(mode="w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", GATEWAY_SERVER.format(repo=str(REPO), seed=seed)],
+        stdout=subprocess.PIPE, stderr=errf, text=True,
+        env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin"},
+    )
+    ready, _, _ = select.select([proc.stdout], [], [], 30.0)
+    line = proc.stdout.readline() if ready else ""
+    if not line.strip():
+        proc.kill()
+        errf.seek(0)
+        raise RuntimeError(f"gateway never came up: {errf.read()[-2000:]}")
+    return proc, int(line)
+
+
+@pytest.fixture(scope="module")
+def clib():
+    r = subprocess.run(["make", "-C", str(CDIR)], capture_output=True, text=True)
+    assert r.returncode == 0, f"C build failed:\n{r.stdout}\n{r.stderr}"
+    return CDIR / "libfdbtpu_c.so"
+
+
+class _CtypesDriver:
+    def __init__(self, db) -> None:
+        self.db = db
+
+    def new_txn(self):
+        outer = self
+
+        class T:
+            def __init__(self) -> None:
+                self.tr = outer.db.create_transaction()
+
+            def set(self, k, v):
+                self.tr.set(k, v)
+
+            def get(self, k):
+                return self.tr.get(k)
+
+            def clear_range(self, b, e):
+                self.tr.clear_range(b, e)
+
+            def get_range(self, b, e, limit):
+                return self.tr.get_range(b, e, limit)
+
+            def atomic_add(self, k, d):
+                self.tr.atomic_add(k, d)
+
+            def commit(self):
+                self.tr.commit()
+
+            def reset(self):
+                self.tr.reset()
+
+        return T()
+
+
+class _GatewayClientDriver:
+    def __init__(self, client) -> None:
+        self.client = client
+
+    def new_txn(self):
+        return self.client.transaction()  # surface already matches
+
+
+class _InProcessDriver:
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        self.db = cluster.database()
+
+    def new_txn(self):
+        c = self.cluster
+        tr = self.db.create_ryw_transaction()
+
+        class T:
+            def set(self, k, v):
+                tr.set(k, v)
+
+            def get(self, k):
+                return c.run_until(c.loop.spawn(tr.get(k)), 300)
+
+            def clear_range(self, b, e):
+                tr.clear_range(b, e)
+
+            def get_range(self, b, e, limit):
+                return c.run_until(
+                    c.loop.spawn(tr.get_range(b, e, limit=limit)), 300
+                )
+
+            def atomic_add(self, k, d):
+                from foundationdb_tpu.roles.types import MutationType
+
+                tr.atomic_op(
+                    MutationType.ADD, k, d.to_bytes(8, "little", signed=True)
+                )
+
+            def commit(self):
+                c.run_until(c.loop.spawn(tr.commit()), 300)
+
+            def reset(self):
+                tr.reset()
+
+        return T()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_three_bindings_conform(seed, clib):
+    from foundationdb_tpu.client.gateway_client import GatewayClient
+    from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+    sys.path.insert(0, str(REPO / "bindings" / "python"))
+    from fdbtpu_ctypes import FdbTpu
+
+    digests = {}
+
+    # binding 1: C ABI over its own fresh gateway cluster
+    proc1, port1 = _spawn_gateway(900 + seed)
+    try:
+        db_c = FdbTpu(str(clib), "127.0.0.1", port1)
+        digests["ctypes"] = digest(_CtypesDriver(db_c), seed)
+        db_c.close()
+    finally:
+        proc1.kill()
+
+    # binding 2: pure-Python gateway client over its own gateway cluster
+    proc2, port2 = _spawn_gateway(950 + seed)
+    try:
+        gc = GatewayClient("127.0.0.1", port2)
+        digests["gateway_py"] = digest(_GatewayClientDriver(gc), seed)
+        gc.close()
+    finally:
+        proc2.kill()
+
+    # binding 3: in-process client on a fresh deterministic cluster
+    c = RecoverableCluster(seed=990 + seed, n_storage_shards=2,
+                           storage_replication=2)
+    digests["in_process"] = digest(_InProcessDriver(c), seed)
+    c.stop()
+
+    assert digests["ctypes"] == digests["gateway_py"], (
+        "C ABI vs gateway-python divergence"
+    )
+    assert digests["gateway_py"] == digests["in_process"], (
+        "gateway-python vs in-process divergence"
+    )

@@ -1,0 +1,79 @@
+"""KeyRangeMap — coalescing range->value map (fdbclient/KeyRangeMap.h)."""
+
+import random
+
+from foundationdb_tpu.utils.rangemap import KeyRangeMap
+
+
+def test_assign_get_and_coalesce():
+    m = KeyRangeMap(default=0)
+    assert m[b""] == 0 and m[b"zzz"] == 0
+    m.assign(b"b", b"f", 1)
+    assert m[b"a"] == 0
+    assert m[b"b"] == 1 and m[b"e"] == 1
+    assert m[b"f"] == 0
+    # adjacent equal values coalesce into one range
+    m.assign(b"f", b"k", 1)
+    assert list(m.ranges()) == [(b"", b"b", 0), (b"b", b"k", 1), (b"k", None, 0)]
+    # overwrite the middle: splits both sides
+    m.assign(b"d", b"g", 2)
+    assert [v for _b, _e, v in m.ranges()] == [0, 1, 2, 1, 0]
+    # assigning the default over everything coalesces back to one range
+    m.assign(b"", None, 0)
+    assert m.boundary_count == 1
+
+
+def test_ranges_clipping_and_unbounded_tail():
+    m = KeyRangeMap(default=b"x")
+    m.assign(b"m", None, b"y")  # to +infinity
+    assert m[b"zzzz"] == b"y"
+    assert list(m.ranges(b"k", b"p")) == [(b"k", b"m", b"x"), (b"m", b"p", b"y")]
+    assert list(m.ranges(b"q")) == [(b"q", None, b"y")]
+
+
+def test_merge_combines_per_subrange():
+    m = KeyRangeMap(default=0)
+    m.assign(b"c", b"h", 5)
+    m.merge(b"a", b"e", 3, max)  # floors merged by max
+    assert [(b, v) for b, _e, v in m.ranges()] == [
+        (b"", 0), (b"a", 3), (b"c", 5), (b"h", 0)
+    ]
+    m.merge(b"c", b"h", 9, max)
+    assert m[b"d"] == 9
+
+
+def test_map_values_clamp():
+    m = KeyRangeMap(default=0)
+    m.assign(b"a", b"b", 3)
+    m.assign(b"c", b"d", 7)
+    m.map_values(lambda v: 0 if v < 5 else v)
+    assert [v for _b, _e, v in m.ranges()] == [0, 7, 0]
+
+
+def test_randomized_against_model():
+    """Model check: the map must agree with a brute-force dict over a
+    discretized keyspace for any interleaving of assigns and merges."""
+    rng = random.Random(7)
+    keys = [bytes([k]) for k in range(16)]
+    m = KeyRangeMap(default=0)
+    model = {k: 0 for k in keys}
+    for _ in range(300):
+        a, b = sorted((rng.randrange(16), rng.randrange(17)))
+        begin = bytes([a])
+        end = None if b == 16 else bytes([b])
+        v = rng.randrange(5)
+        if rng.random() < 0.5:
+            m.assign(begin, end, v)
+            for k in keys:
+                if k >= begin and (end is None or k < end):
+                    model[k] = v
+        else:
+            m.merge(begin, end, v, max)
+            for k in keys:
+                if k >= begin and (end is None or k < end):
+                    model[k] = max(model[k], v)
+        for k in keys:
+            assert m[k] == model[k], (k, m._keys, m._vals)
+        # coalescing invariant: no equal adjacent values
+        vs = m._vals
+        assert all(vs[i] != vs[i + 1] for i in range(len(vs) - 1))
