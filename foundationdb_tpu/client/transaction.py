@@ -92,8 +92,11 @@ class ClusterView:
         self.smap = storage_map
         self.epoch = epoch
         # special key space handlers (SpecialKeySpace.actor.cpp): module
-        # reads under \xff\xff, e.g. the status-client path
+        # reads under \xff\xff, e.g. the status-client path.  special_keys
+        # answers exact-key gets; special_ranges is [(prefix, handler)] for
+        # module RANGE reads (handler() -> [(key, value)] rows)
         self.special_keys: dict[bytes, object] = {}
+        self.special_ranges: list[tuple[bytes, object]] = []
 
 
 class QueueModel:
@@ -432,9 +435,17 @@ class Transaction:
             # under \xff\xff are answered by module handlers, not storage —
             # e.g. \xff\xff/status/json is the status-client fetch path
             handler = self.db.view.special_keys.get(key)
-            if handler is None:
-                return None
-            return handler()
+            if handler is not None:
+                return handler()
+            # range modules answer exact gets too (SpecialKeySpace: a get
+            # inside a module's range resolves against its rows)
+            for prefix, rh in self.db.view.special_ranges:
+                if key.startswith(prefix):
+                    for k, v in rh():
+                        if k == key:
+                            return v
+                    return None
+            return None
         v = await self.get_read_version()
         # loadBalance (fdbrpc/LoadBalance.actor.h:159): pick a random replica
         # of the shard's team per attempt; _reply_rerouted re-picks on a
@@ -454,6 +465,18 @@ class Transaction:
     async def get_range(
         self, begin: bytes, end: bytes, limit: int = 10000, snapshot: bool = False
     ) -> list[tuple[bytes, bytes]]:
+        if begin.startswith(b"\xff\xff"):
+            # special-key-space MODULE range read (SpecialKeySpace.actor.cpp:
+            # `\xff\xff/<module>/...` ranges answered by handlers, not
+            # storage — e.g. \xff\xff/keyservers/, \xff\xff/excluded/)
+            out = []
+            for prefix, handler in self.db.view.special_ranges:
+                if begin < prefix + b"\xff" and prefix < end:
+                    out.extend(
+                        (k, v) for k, v in handler()
+                        if begin <= k < end
+                    )
+            return sorted(out)[:limit]
         v = await self.get_read_version()
         out: list[tuple[bytes, bytes]] = []
         smap = self.db._smap

@@ -599,6 +599,40 @@ class RecoverableCluster:
         # special key space handlers (SpecialKeySpace.actor.cpp): the
         # status-client path reads \xff\xff/status/json like any key
         view.special_keys = {b"\xff\xff/status/json": _status_json}
+
+        # range modules — the readable SystemData vocabulary
+        # (fdbclient/SystemData.cpp keyServersPrefix / excludedServersPrefix
+        # / serverListKeys re-designed as \xff\xff modules: the authoritative
+        # state lives in the controller, these views read it like keys)
+        def _keyservers_rows():
+            cc = self.controller
+            bounds = [b""] + list(cc.storage_splits)
+            return [
+                (b"\xff\xff/keyservers/" + bounds[i],
+                 b",".join(t.encode() for t in team))
+                for i, team in enumerate(cc.storage_teams_tags)
+            ]
+
+        def _excluded_rows():
+            return [
+                (b"\xff\xff/excluded/" + t.encode(), b"1")
+                for t in sorted(self.controller.excluded_targets)
+            ]
+
+        def _serverlist_rows():
+            cc = self.controller
+            return [
+                (b"\xff\xff/server_list/" + tag.encode(),
+                 f"{ss.process.name}@{ss.process.address.ip}:"
+                 f"{ss.process.address.port}".encode())
+                for tag, ss in sorted(cc._tag_to_ss.items())
+            ]
+
+        view.special_ranges = [
+            (b"\xff\xff/keyservers/", _keyservers_rows),
+            (b"\xff\xff/excluded/", _excluded_rows),
+            (b"\xff\xff/server_list/", _serverlist_rows),
+        ]
         return Database(self.loop, view, self.rng,
                         client_knobs=self.client_knobs)
 

@@ -79,9 +79,27 @@ def cluster_status(cluster) -> dict[str, Any]:
             "heals": dd.heals,
             "shard_splits": dd.shard_splits,
             "shards": len(controller.storage_teams_tags),
+            "exclusion_drains": dd.exclusion_drains,
         }
     if controller is not None:
         doc["cluster"]["backup_running"] = controller.backup_worker is not None
+        # round-5 operational surface (ManagementAPI state + liveness map)
+        fm = controller.failure_monitor
+        doc["cluster"]["configuration"] = {
+            "excluded": sorted(controller.excluded_targets),
+            "locked": controller._locked is not None,
+            "coordinators": len(getattr(cluster, "coordinators", []) or []),
+            "maintenance_zones": sorted(controller.maintenance_zones),
+            "redundancy_policy": repr(controller.replication_policy)
+            if controller.replication_policy is not None else None,
+            "team_sizes": [len(t) for t in controller.storage_teams_tags],
+        }
+        doc["cluster"]["failure_monitor"] = {
+            "tracked": len(fm._status),
+            "failed": [str(a) for a in fm.failed_addresses()],
+            "transitions": fm.transitions,
+        }
+        doc["cluster"]["stream_consumers"] = sorted(controller.stream_consumers)
     rk = getattr(cluster, "ratekeeper", None)
     if rk is not None:
         doc["ratekeeper"] = rk.status()
@@ -109,8 +127,21 @@ STATUS_SCHEMA: dict = {
         "latest_events": dict,
         "data_distribution?": {
             "moves": int, "heals": int, "shard_splits": int, "shards": int,
+            "exclusion_drains": int,
         },
         "backup_running?": bool,
+        "configuration?": {
+            "excluded": list,
+            "locked": bool,
+            "coordinators": int,
+            "maintenance_zones": list,
+            "redundancy_policy": (str, type(None)),
+            "team_sizes": list,
+        },
+        "failure_monitor?": {
+            "tracked": int, "failed": list, "transitions": int,
+        },
+        "stream_consumers?": list,
     },
     "proxy": {
         "committed_version": int,

@@ -1,0 +1,73 @@
+"""ConfigureDatabase workload — random online reconfiguration under load
+(fdbserver/workloads/ConfigureDatabase.actor.cpp: flip role counts and
+redundancy modes mid-traffic; every flip must preserve every invariant).
+
+Each step commits a random `configure` change (n_tlogs / n_proxies /
+n_resolvers / redundancy double<->triple) and waits for the cluster to
+converge before the next.  Runs composed with an invariant workload
+(Cycle, Increment) whose checks prove no flip lost or forked data."""
+
+from __future__ import annotations
+
+from .base import Workload
+from ..client.management import configure
+
+
+class ConfigureDatabaseWorkload(Workload):
+    description = "ConfigureDatabase"
+
+    def __init__(self, flips: int = 3, interval: float = 1.5,
+                 include_redundancy: bool = True):
+        self.flips = flips
+        self.interval = interval
+        self.include_redundancy = include_redundancy
+        self.applied = 0
+        self.converged = 0
+
+    async def start(self, cluster, rng) -> None:
+        db = cluster.database()
+        cc = cluster.controller
+        for _ in range(self.flips):
+            await cluster.loop.delay(self.interval)
+            # random_int is half-open [lo, hi)
+            choice = rng.random_int(0, 4 if self.include_redundancy else 3)
+            if choice == 0:
+                want = {"n_tlogs": rng.random_int(2, 4)}
+            elif choice == 1:
+                want = {"n_proxies": rng.random_int(1, 3)}
+            elif choice == 2:
+                want = {"n_resolvers": rng.random_int(1, 3)}
+            else:
+                want = {"redundancy": rng.random_choice(["double", "triple"])}
+            await configure(db, **want)
+            self.applied += 1
+
+            def done() -> bool:
+                gen = cc.generation
+                if gen is None or cc._recovering:
+                    return False
+                if "n_tlogs" in want and len(gen.tlogs) != want["n_tlogs"]:
+                    return False
+                if "n_proxies" in want and len(gen.proxies) != want["n_proxies"]:
+                    return False
+                if "n_resolvers" in want and len(gen.resolvers) != want["n_resolvers"]:
+                    return False
+                if "redundancy" in want:
+                    target = 2 if want["redundancy"] == "double" else 3
+                    if any(len(t) != target for t in cc.storage_teams_tags):
+                        return False
+                return True
+
+            for _ in range(600):
+                if done():
+                    self.converged += 1
+                    break
+                await cluster.loop.delay(0.1)
+
+    async def check(self, cluster, rng) -> bool:
+        # every requested flip converged (partial convergence = a wedged
+        # reconfiguration path)
+        return self.converged == self.applied
+
+    def metrics(self) -> dict:
+        return {"applied": self.applied, "converged": self.converged}

@@ -91,3 +91,44 @@ def test_trace_sink_streams_jsonl():
     lines = [json.loads(l) for l in sink.getvalue().splitlines() if l.strip()]
     assert any(e["Type"] == "MasterRecoveryState" for e in lines)
     assert all("Time" in e and "Severity" in e for e in lines)
+
+
+def test_special_key_range_modules():
+    """SpecialKeySpace RANGE modules: \xff\xff/keyservers/, /excluded/,
+    /server_list/ read controller metadata like keys (the readable
+    SystemData vocabulary, fdbclient/SystemData.cpp)."""
+    from foundationdb_tpu.client import management as mgmt
+    from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+    c = RecoverableCluster(seed=560, n_storage_shards=2, storage_replication=2)
+    db = c.database()
+
+    async def main():
+        rows = await db.create_transaction().get_range(
+            b"\xff\xff/keyservers/", b"\xff\xff/keyservers0"
+        )
+        assert len(rows) == 2  # one row per shard
+        assert rows[0][0] == b"\xff\xff/keyservers/"
+        teams0 = rows[0][1].split(b",")
+        assert len(teams0) == 2  # replication factor
+
+        srv = await db.create_transaction().get_range(
+            b"\xff\xff/server_list/", b"\xff\xff/server_list0"
+        )
+        assert len(srv) == 4  # 2 shards x 2 replicas
+        assert all(b"@" in v for _k, v in srv)
+
+        # exclusion shows up in the excluded module once committed + polled
+        await mgmt.exclude(db, ["bogus-machine"])
+        for _ in range(100):
+            await c.loop.delay(0.1)
+            if c.controller.excluded_targets:
+                break
+        ex = await db.create_transaction().get_range(
+            b"\xff\xff/excluded/", b"\xff\xff/excluded0"
+        )
+        assert ex == [(b"\xff\xff/excluded/bogus-machine", b"1")]
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 300)
+    c.stop()

@@ -110,3 +110,22 @@ def test_restarting_pair_cycle():
     # ...and more rotations committed on the restarted cluster
     assert metrics2["Cycle"]["committed"] == 12
     c2.stop()
+
+
+def test_configure_database_swizzle_with_cycle():
+    """Random role-count + redundancy flips under a Cycle load: every flip
+    converges and the ring invariant holds throughout (the reference's
+    ConfigureDatabase workload composed with an invariant checker)."""
+    from foundationdb_tpu.workloads.configure_db import ConfigureDatabaseWorkload
+
+    c = RecoverableCluster(
+        seed=547, n_machines=6, n_dcs=2, n_storage_shards=2,
+        redundancy="double",
+    )
+    cyc = CycleWorkload(nodes=8, clients=2, txns_per_client=10)
+    cfg = ConfigureDatabaseWorkload(flips=3, interval=1.0)
+    metrics = run_workloads(c, [cyc, cfg], deadline=900.0)
+    assert metrics["Cycle"]["committed"] == 20
+    assert metrics["ConfigureDatabase"]["applied"] == 3
+    assert metrics["ConfigureDatabase"]["converged"] == 3
+    c.stop()
