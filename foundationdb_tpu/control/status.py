@@ -68,6 +68,12 @@ def cluster_status(cluster) -> dict[str, Any]:
                 "version": ss.version.get(),
                 "durable_version": ss.durable_version,
                 "keys": ss.store.key_count(),
+                # ssd engine only: page-cache accounting (AsyncFileCached)
+                **(
+                    {"cache_hits": ss.store.cache_hits,
+                     "cache_misses": ss.store.cache_misses}
+                    if hasattr(ss.store, "cache_hits") else {}
+                ),
             }
             for ss in cluster.storage
         ],

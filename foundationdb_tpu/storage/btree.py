@@ -74,6 +74,10 @@ class BTreeKeyValueStore:
         self._file_id = 0
         self._appended = 0
         self._live_bytes = 1
+        # page-cache accounting (AsyncFileCached's hit/miss counters —
+        # surfaced through the storage status rows)
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     # ---- mutation -----------------------------------------------------------
     def set(self, key: bytes, value: bytes) -> None:
@@ -369,8 +373,10 @@ class BTreeKeyValueStore:
         key = (self._file_id, off)
         hit = self._cache.get(key)
         if hit is not None:
+            self.cache_hits += 1
             self._cache.move_to_end(key)
             return hit
+        self.cache_misses += 1
         f = self._files[self._file_id]
         head = f.pread(off, 8)
         r = BinaryReader(head)

@@ -1,0 +1,160 @@
+"""Spec-file-driven simulation runs — the tests/fast/*.txt analog
+(fdbserver/tester.actor.cpp:848 readTests; the reference composes
+workloads from key=value stanzas and runs them against a simulated
+cluster, e.g. tests/fast/CycleTest.txt = Cycle + RandomClogging +
+Attrition concurrently).
+
+Format (one file = one simulation):
+
+    testTitle=CycleWithChaos
+    ; cluster parameters (optional, defaults in brackets)
+    seed=7
+    shards=2
+    replication=2
+    machines=4
+    chaos=true
+
+    testName=Cycle
+    nodes=8
+    clients=2
+    txnsPerClient=6
+
+    testName=Attrition
+    kills=1
+    interval=2.0
+
+`testName` opens a workload stanza; parameters until the next `testName`
+are constructor kwargs (camelCase -> snake_case).  Everything before the
+first `testName` configures the cluster.  `run_spec` builds the cluster,
+composes the workloads, runs them, and returns the metrics dict."""
+
+from __future__ import annotations
+
+import re
+
+from .attrition import AttritionWorkload
+from .bank import BankWorkload
+from .base import run_workloads
+from .configure_db import ConfigureDatabaseWorkload
+from .conflict_range import ConflictRangeWorkload
+from .consistency import ConsistencyCheckWorkload
+from .cycle import CycleWorkload
+from .fuzzapi import FuzzApiWorkload
+from .increment import IncrementWorkload
+from .serializability import SerializabilityWorkload
+
+# WorkloadFactory (workloads.h:55 registration): spec testName -> class
+WORKLOAD_FACTORY = {
+    "Cycle": CycleWorkload,
+    "Bank": BankWorkload,
+    "Increment": IncrementWorkload,
+    "Attrition": AttritionWorkload,
+    "ConsistencyCheck": ConsistencyCheckWorkload,
+    "ConflictRange": ConflictRangeWorkload,
+    "Serializability": SerializabilityWorkload,
+    "FuzzApi": FuzzApiWorkload,
+    "ConfigureDatabase": ConfigureDatabaseWorkload,
+}
+
+# spec key -> RecoverableCluster kwarg
+_CLUSTER_KEYS = {
+    "seed": ("seed", int),
+    "shards": ("n_storage_shards", int),
+    "replication": ("storage_replication", int),
+    "machines": ("n_machines", int),
+    "dcs": ("n_dcs", int),
+    "workers": ("n_workers", int),
+    "tlogs": ("n_tlogs", int),
+    "proxies": ("n_proxies", int),
+    "resolvers": ("n_resolvers", int),
+    "engine": ("storage_engine", str),
+    "redundancy": ("redundancy", str),
+    "chaos": ("chaos", "bool"),
+}
+
+
+def _parse_bool(v: str) -> bool:
+    if v.lower() not in ("true", "false"):
+        raise ValueError(f"expected true/false, got {v!r}")
+    return v.lower() == "true"
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+
+
+def _coerce(v: str):
+    for conv in (int, float):
+        try:
+            return conv(v)
+        except ValueError:
+            continue
+    if v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return v
+
+
+def parse_spec(text: str) -> tuple[str, dict, list[tuple[str, dict]]]:
+    """-> (title, cluster_kwargs, [(workload_name, kwargs), ...])"""
+    title = "untitled"
+    cluster_kwargs: dict = {}
+    stanzas: list[tuple[str, dict]] = []
+    current: dict | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith((";", "#")):
+            continue
+        if "=" not in line:
+            raise ValueError(f"line {lineno}: expected key=value, got {line!r}")
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "testTitle":
+            title = val
+        elif key == "testName":
+            if val not in WORKLOAD_FACTORY:
+                raise ValueError(
+                    f"line {lineno}: unknown workload {val!r}; "
+                    f"registered: {sorted(WORKLOAD_FACTORY)}"
+                )
+            current = {}
+            stanzas.append((val, current))
+        elif current is not None:
+            current[_snake(key)] = _coerce(val)
+        elif key in _CLUSTER_KEYS:
+            kw, conv = _CLUSTER_KEYS[key]
+            try:
+                cluster_kwargs[kw] = (
+                    _parse_bool(val) if conv == "bool" else conv(val)
+                )
+            except ValueError as e:
+                raise ValueError(f"line {lineno}: {key}: {e}") from None
+        else:
+            raise ValueError(
+                f"line {lineno}: unknown cluster key {key!r} "
+                f"(known: {sorted(_CLUSTER_KEYS)})"
+            )
+    if not stanzas:
+        raise ValueError("spec has no testName stanza")
+    return title, cluster_kwargs, stanzas
+
+
+def run_spec(text: str, deadline: float = 900.0) -> dict:
+    """Parse, build the cluster, compose the workloads, run, check."""
+    from ..control.recoverable import RecoverableCluster
+    from ..runtime import buggify
+
+    title, cluster_kwargs, stanzas = parse_spec(text)
+    c = RecoverableCluster(**cluster_kwargs)
+    try:
+        workloads = [WORKLOAD_FACTORY[name](**kw) for name, kw in stanzas]
+        metrics = run_workloads(c, workloads, deadline=deadline)
+        metrics["testTitle"] = title
+        return metrics
+    finally:
+        c.stop()
+        buggify.disable()
+
+
+def run_spec_file(path: str, deadline: float = 900.0) -> dict:
+    with open(path) as f:
+        return run_spec(f.read(), deadline=deadline)

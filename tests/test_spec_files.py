@@ -1,0 +1,39 @@
+"""Spec-file-driven simulation runs (tester.actor.cpp readTests): every
+tests/specs/*.txt is parsed, composed, and run — the tests/fast/ corpus
+shape."""
+
+import pathlib
+
+import pytest
+
+from foundationdb_tpu.workloads.spec import parse_spec, run_spec_file
+
+SPEC_DIR = pathlib.Path(__file__).parent / "specs"
+SPECS = sorted(SPEC_DIR.glob("*.txt"))
+
+
+def test_corpus_not_empty():
+    assert len(SPECS) >= 4
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda p: p.stem)
+def test_spec_file_runs_green(spec):
+    metrics = run_spec_file(str(spec), deadline=900.0)
+    assert metrics["testTitle"]
+
+
+def test_parse_rejects_unknowns():
+    with pytest.raises(ValueError, match="unknown workload"):
+        parse_spec("testName=NoSuchWorkload\n")
+    with pytest.raises(ValueError, match="unknown cluster key"):
+        parse_spec("bogus=1\ntestName=Cycle\n")
+    with pytest.raises(ValueError, match="no testName"):
+        parse_spec("seed=1\n")
+
+
+def test_camel_case_mapping():
+    _t, ck, st = parse_spec(
+        "seed=5\nchaos=true\ntestName=Cycle\ntxnsPerClient=7\n"
+    )
+    assert ck == {"seed": 5, "chaos": True}
+    assert st == [("Cycle", {"txns_per_client": 7})]
