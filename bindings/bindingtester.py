@@ -64,7 +64,14 @@ def gen_ops(seed: int, n: int = 120) -> list[tuple]:
         elif k == 8:
             ops.append(("ATOMIC_ADD", key(), rng.randrange(-50, 50)))
         elif k == 9:
-            ops.append(("GET_STACK_TOP",))
+            if rng.random() < 0.5:
+                # behavior-neutral here (no lock held / fences unused), but
+                # every binding must accept and route the option
+                ops.append(("SET_OPTION", rng.choice(
+                    [b"lock_aware", b"causal_write_risky"]
+                )))
+            else:
+                ops.append(("GET_STACK_TOP",))
         elif k == 10:
             ops.append(("COMMIT",))
         else:
@@ -106,6 +113,8 @@ class StackMachine:
                 self.log.append(("range", op[1], op[2], op[3], packed))
             elif kind == "ATOMIC_ADD":
                 tr.atomic_add(op[1], op[2])
+            elif kind == "SET_OPTION":
+                tr.set_option(op[1])
             elif kind == "GET_STACK_TOP":
                 self.log.append(("top", self.stack[-1] if self.stack else b"EMPTY"))
             elif kind == "COMMIT":

@@ -166,6 +166,18 @@ int fdbtpu_txn_clear_range(FDBTPU_Database *db, uint64_t txn,
   return st;
 }
 
+int fdbtpu_txn_set_option(FDBTPU_Database *db, uint64_t txn,
+                          const uint8_t *option, uint32_t option_len) {
+  uint32_t blen = 8 + 4 + option_len;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, option_len);
+  memcpy(b + 12, option, option_len);
+  int st = rpc(db, 13, b, blen, NULL, NULL);
+  free(b);
+  return st;
+}
+
 int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
                           const uint8_t *key, uint32_t key_len, int64_t delta) {
   uint32_t blen = 8 + 4 + key_len + 8;

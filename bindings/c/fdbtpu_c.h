@@ -35,6 +35,11 @@ int fdbtpu_txn_set(FDBTPU_Database *db, uint64_t txn,
 int fdbtpu_txn_clear_range(FDBTPU_Database *db, uint64_t txn,
                            const uint8_t *begin, uint32_t begin_len,
                            const uint8_t *end, uint32_t end_len);
+/* transaction option by name (e.g. "lock_aware", "causal_write_risky") —
+ * the vexillographer-generated option vocabulary of the python client */
+int fdbtpu_txn_set_option(FDBTPU_Database *db, uint64_t txn,
+                          const uint8_t *option, uint32_t option_len);
+
 int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
                           const uint8_t *key, uint32_t key_len, int64_t delta);
 

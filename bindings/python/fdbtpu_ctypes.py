@@ -110,6 +110,13 @@ class _Txn:
     def reset(self) -> None:
         self._db._check(self._db._lib.fdbtpu_txn_reset(self._db._h, self._tid))
 
+    def set_option(self, option: bytes) -> None:
+        self._db._check(
+            self._db._lib.fdbtpu_txn_set_option(
+                self._db._h, self._tid, option, len(option)
+            )
+        )
+
     def destroy(self) -> None:
         self._db._lib.fdbtpu_txn_destroy(self._db._h, self._tid)
 
@@ -134,6 +141,7 @@ class FdbTpu:
                                                u32, C.c_char_p, u32]
         lib.fdbtpu_txn_atomic_add.argtypes = [C.c_void_p, u64, C.c_char_p,
                                               u32, i64]
+        lib.fdbtpu_txn_set_option.argtypes = [C.c_void_p, u64, C.c_char_p, u32]
         lib.fdbtpu_txn_get.argtypes = [C.c_void_p, u64, C.c_char_p, u32,
                                        C.POINTER(C.c_int), C.POINTER(u8p),
                                        C.POINTER(u32)]

@@ -96,6 +96,9 @@ class Transaction:
         body = self._db._call(11, self._body())
         return struct.unpack_from("<q", body, 0)[0]
 
+    def set_option(self, option: bytes) -> None:
+        self._db._call(13, self._body(option))
+
     def commit(self) -> int:
         body = self._db._call(8, self._body())
         return struct.unpack_from("<q", body, 0)[0]

@@ -200,6 +200,26 @@ async def set_coordinators(db, n: int) -> None:
     await db.run(fn)
 
 
+# -- throttle (fdbcli `throttle`: an operator TPS ceiling) -------------------
+
+
+async def set_throttle(db, tps: float | None) -> None:
+    """Cap cluster admission at `tps` transactions/s (None = clear).
+    Composes with the automatic ratekeeper model as a hard ceiling."""
+
+    async def fn(tr):
+        if tps is None:
+            tr.clear(CONF_PREFIX + b"throttle_tps")
+        else:
+            import math
+
+            if not math.isfinite(tps) or tps <= 0:
+                raise ValueError("throttle tps must be a finite positive number")
+            tr.set(CONF_PREFIX + b"throttle_tps", repr(float(tps)).encode())
+
+    await db.run(fn)
+
+
 # -- maintenance mode (fdbcli `maintenance on <zone> <seconds>`) -------------
 
 

@@ -1175,6 +1175,7 @@ class ClusterController:
             coord_n: int | None = None
             maint: dict[str, float] = {}
             redundancy: str | None = None
+            throttle: float | None = None
             for k, v in rows:
                 if k.startswith(EXCLUDED_PREFIX):
                     excluded.add(k[len(EXCLUDED_PREFIX):].decode())
@@ -1200,6 +1201,12 @@ class ClusterController:
                     except UnicodeDecodeError:
                         pass
                     continue
+                if k == CONF_PREFIX + b"throttle_tps":
+                    try:
+                        throttle = float(v)
+                    except ValueError:
+                        pass
+                    continue
                 try:
                     conf[k[len(CONF_PREFIX):].decode()] = int(v)
                 except (ValueError, UnicodeDecodeError):
@@ -1221,6 +1228,11 @@ class ClusterController:
             self.maintenance_zones = {
                 z: d for z, d in maint.items() if d > self.loop.now()
             }
+
+            # operator throttle (fdbcli `throttle`): a hard TPS ceiling on
+            # the ratekeeper's admission budget
+            if self.ratekeeper is not None:
+                self.ratekeeper.manual_tps_cap = throttle
 
             # coordinator-set change (changeQuorum): delegated to the
             # assembly-installed hook, which owns Coordinator construction

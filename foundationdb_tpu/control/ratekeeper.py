@@ -37,6 +37,9 @@ class Ratekeeper:
         self.max_tps = max_tps
         self.tps_budget = max_tps
         self.batch_tps_budget = max_tps
+        # operator-imposed cap (fdbcli `throttle`, `\xff/conf/throttle_tps`):
+        # an upper bound composed with the automatic model, None = off
+        self.manual_tps_cap: float | None = None
         self.limit_reason = "unlimited"
         self.limiting_server: str | None = None
         self._lag_smoothers: dict[str, Smoother] = {}
@@ -105,8 +108,14 @@ class Ratekeeper:
         for tag in [t for t in self._lag_smoothers if t not in live_tags]:
             del self._lag_smoothers[tag]
 
+        if self.manual_tps_cap is not None and self.manual_tps_cap < tps:
+            tps, reason, limiting = self.manual_tps_cap, "manual_throttle", None
+
         self._budget.set_total(tps)
         self.tps_budget = max(self._budget.smooth_total(), self.max_tps * 0.01)
+        if self.manual_tps_cap is not None:
+            # the cap is a hard ceiling, not a smoothed target
+            self.tps_budget = min(self.tps_budget, self.manual_tps_cap)
         # batch-priority budget (the reference's separate batch limit):
         # batch traffic starves FIRST — it reaches zero while default-class
         # work still has 25% of the full rate left

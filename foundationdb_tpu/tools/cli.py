@@ -34,6 +34,7 @@ commands:
   lock | unlock <uid>         lock/unlock the database (error 1038 to others)
   coordinators <n>            change the coordinator quorum size
   maintenance <zone> <secs>   suppress healing for a zone while it bounces
+  throttle <tps>|off          cap cluster admission at tps transactions/s
   move <begin> <end> <shard>  MoveKeys: migrate a range to shard's team
   backup start <prefix>       continuous backup + snapshot into the cluster fs
   backup status | stop        backup progress / stop
@@ -173,6 +174,12 @@ class Cli:
 
             self._run(mgmt.set_maintenance(self.db, args[0], float(args[1])))
             return f"maintenance on {args[0]} for {args[1]}s"
+        if cmd == "throttle":
+            from ..client import management as mgmt
+
+            tps = None if args[0] == "off" else float(args[0])
+            self._run(mgmt.set_throttle(self.db, tps))
+            return "throttle cleared" if tps is None else f"throttled to {tps} tps"
         if cmd == "move":
             # move BEGIN END SHARD_IDX — MoveKeys through data distribution
             dest = c.controller.storage_teams_tags[int(args[2])]

@@ -26,6 +26,8 @@ Ops (body → reply body):
                                                  retryable; else status=code)
    10 ATOMIC_ADD   u64, key, i64 delta         → ()
    11 GET_READ_VERSION u64                     → i64 version
+   13 SET_OPTION   u64, option                 → ()   (transaction option by
+                                                 name, e.g. lock_aware)
 
 Status: 0 ok; 1 not_committed, 2 transaction_too_old, 3
 commit_unknown_result, 4 future_version, 5 timed_out, 6 bad request,
@@ -262,6 +264,12 @@ class ClientGateway:
                 elif op == 11:  # GET_READ_VERSION
                     v = await tr.get_read_version()
                     out += struct.pack("<q", v)
+                elif op == 13:  # SET_OPTION
+                    name, off = _bstr(body, off)
+                    try:
+                        tr.set_option(name)
+                    except ValueError:
+                        status = ERR_BAD_REQUEST
                 else:
                     status = ERR_BAD_REQUEST
             self._reply(conn, req_id, status, bytes(out))

@@ -95,6 +95,9 @@ class _CtypesDriver:
             def reset(self):
                 self.tr.reset()
 
+            def set_option(self, option):
+                self.tr.set_option(option)
+
         return T()
 
 
@@ -142,6 +145,9 @@ class _InProcessDriver:
 
             def reset(self):
                 tr.reset()
+
+            def set_option(self, option):
+                tr.set_option(option)
 
         return T()
 

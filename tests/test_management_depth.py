@@ -200,3 +200,41 @@ def test_maintenance_suppresses_healing():
     heals = c.run_until(c.loop.spawn(main()), 600)
     assert heals >= 1  # maintenance over: the dead replica heals normally
     c.stop()
+
+
+def test_manual_throttle_caps_admission():
+    """fdbcli `throttle`: an operator TPS ceiling composed with the
+    automatic ratekeeper model; clearing restores the model's budget."""
+    c = RecoverableCluster(seed=515)
+    db = c.database()
+
+    async def main():
+        await mgmt.set_throttle(db, 50.0)
+        for _ in range(100):
+            await c.loop.delay(0.1)
+            if c.ratekeeper.manual_tps_cap == 50.0:
+                break
+        assert c.ratekeeper.manual_tps_cap == 50.0
+        # the budget converges under the ceiling
+        for _ in range(100):
+            await c.loop.delay(0.1)
+            if c.ratekeeper.tps_budget <= 50.0:
+                break
+        assert c.ratekeeper.tps_budget <= 50.0
+        assert c.ratekeeper.limit_reason == "manual_throttle"
+        # commits still flow (throttled, not blocked)
+        async def w(tr):
+            tr.set(b"thr", b"1")
+        await db.run(w)
+        await mgmt.set_throttle(db, None)
+        for _ in range(200):
+            await c.loop.delay(0.1)
+            if c.ratekeeper.manual_tps_cap is None and \
+                    c.ratekeeper.tps_budget > 50.0:
+                break
+        assert c.ratekeeper.manual_tps_cap is None
+        assert c.ratekeeper.tps_budget > 50.0
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 300)
+    c.stop()
