@@ -261,3 +261,26 @@ def test_sharded_lsm_pipelined_stream(mesh):
     for got_dev, want, B in pending:
         got = [Verdict(int(c)) for c in np.asarray(got_dev)[:B]]
         assert got == want
+
+
+def test_sharded_gather_merge_matches_multi_oracle(mesh):
+    """The gather merge under shard_map on the 4-device mesh (searchsorted
+    rank trick + row gathers inside a sharded kernel) — bit-parity with the
+    multi-partition oracle, single-level and LSM."""
+    rng = random.Random(23)
+    dev = ShardedDeviceConflictSet(
+        mesh, SPLITS, capacity=1 << 10, merge_impl="gather"
+    )
+    lsm = ShardedDeviceConflictSet(
+        mesh, SPLITS, capacity=1 << 10, merge_impl="gather",
+        lsm=True, recent_capacity=1 << 6,
+    )
+    ref = MultiOracle(SPLITS)
+    version = 0
+    for _ in range(25):
+        version += rng.randrange(1, 5)
+        txns = [random_tx(rng, max(version - 8, 0), version - 1)
+                for _ in range(rng.randrange(1, 9))]
+        want = ref.resolve_batch(version, txns)
+        assert dev.resolve_batch(version, txns) == want
+        assert lsm.resolve_batch(version, txns) == want
