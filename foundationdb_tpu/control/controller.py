@@ -1251,37 +1251,12 @@ class ClusterController:
                 except Exception as e:  # noqa: BLE001 — next poll retries
                     self.trace.trace("CoordinatorsChangeError", Error=repr(e))
 
-            # redundancy flip (configure redundancy=double/triple/...): data
-            # distribution converges one replica per poll until every team
-            # matches the policy's factor
-            if redundancy is not None and self.on_redundancy_change is not None:
-                try:
-                    from ..rpc.policy import policy_for_redundancy
-
-                    policy = policy_for_redundancy(redundancy)
-                    target = policy.replicas()
-                    if any(len(t) != target for t in self.storage_teams_tags):
-                        self.replication_policy = policy
-                        self._redundancy_pending = True
-                        await self.on_redundancy_change(policy)
-                    elif getattr(self, "_redundancy_pending", False):
-                        # transition to converged: every team now matches
-                        self._redundancy_pending = False
-                        testcov("management.redundancy_converged")
-                        self.trace.trace(
-                            "RedundancyChanged", Mode=redundancy,
-                            Epoch=self.epoch,
-                        )
-                except ValueError:
-                    self.trace.trace("RedundancyModeUnknown", Mode=redundancy)
-                except Exception as e:  # noqa: BLE001 — next poll retries
-                    self.trace.trace("RedundancyChangeError", Error=repr(e))
-
             # exclusion: targets hosting pipeline roles force a recovery
             # (recruitment avoids excluded machines/workers); storage drains
             # via data distribution's exclusion loop.  The role check runs
             # EVERY poll, not only on change — a failed recovery must be
-            # retried next tick
+            # retried next tick.  Processed BEFORE the redundancy step so a
+            # slow replica grow can never delay an exclusion taking effect.
             if excluded != self.excluded_targets:
                 self.excluded_targets = excluded
                 self.trace.trace(
@@ -1294,6 +1269,43 @@ class ClusterController:
                 except Exception:  # noqa: BLE001 — next poll retries
                     pass
                 continue
+
+            # redundancy flip (configure redundancy=double/triple/...): data
+            # distribution converges one replica per step until every team
+            # matches.  A step can take tens of seconds (snapshot fetch +
+            # durability wait), so it runs as a BACKGROUND task — the watch
+            # must stay responsive for lock/exclusion/coordinator changes
+            if redundancy is not None and self.on_redundancy_change is not None:
+                try:
+                    from ..rpc.policy import policy_for_redundancy
+
+                    policy = policy_for_redundancy(redundancy)
+                except ValueError:
+                    self.trace.trace("RedundancyModeUnknown", Mode=redundancy)
+                else:
+                    target = policy.replicas()
+                    if any(len(t) != target for t in self.storage_teams_tags):
+                        self.replication_policy = policy
+                        self._redundancy_pending = True
+                        t = getattr(self, "_redundancy_step_task", None)
+                        if t is None or t.done():
+                            self._redundancy_step_task = self.loop.spawn(
+                                self._redundancy_step(policy),
+                                TaskPriority.COORDINATION, "cc-redundancy",
+                            )
+                    elif getattr(self, "_redundancy_pending", False):
+                        t = getattr(self, "_redundancy_step_task", None)
+                        if t is None or t.done():
+                            # converged — declared only with no step in
+                            # flight: an installed-but-not-yet-durable grow
+                            # can still roll back (the durability wait may
+                            # time out), so mid-step team sizes don't count
+                            self._redundancy_pending = False
+                            testcov("management.redundancy_converged")
+                            self.trace.trace(
+                                "RedundancyChanged", Mode=redundancy,
+                                Epoch=self.epoch,
+                            )
             want_tlogs = conf.get("n_tlogs", len(gen.tlogs))
             want_proxies = conf.get("n_proxies", len(gen.proxies))
             want_res = conf.get("n_resolvers", len(gen.resolvers))
@@ -1318,6 +1330,17 @@ class ClusterController:
                 await self._recover()
             except Exception:  # noqa: BLE001 — next poll re-detects the
                 continue       # actual-vs-desired mismatch and retries
+
+    async def _redundancy_step(self, policy) -> None:
+        """One replica-change step, off the conf watch's critical path."""
+        from ..runtime.core import ActorCancelled
+
+        try:
+            await self.on_redundancy_change(policy)
+        except ActorCancelled:
+            raise  # stop() cancelling a step is not an error
+        except Exception as e:  # noqa: BLE001 — next poll respawns
+            self.trace.trace("RedundancyChangeError", Error=repr(e))
 
     # -- failure monitoring -------------------------------------------------
     async def _monitor(self) -> None:
@@ -1355,6 +1378,8 @@ class ClusterController:
                     )
 
     def stop(self) -> None:
+        if getattr(self, "_redundancy_step_task", None) is not None:
+            self._redundancy_step_task.cancel()
         if getattr(self, "_register_task", None) is not None:
             self._register_task.cancel()
         if getattr(self, "_balance_task", None) is not None:

@@ -190,3 +190,68 @@ def test_device_lsm_kernel_in_chaos_cluster():
     assert m["Increment"]["committed"] == 8
     assert c.controller.recoveries >= 1
     c.stop()
+
+
+def test_round5_feature_sink():
+    """Round-5 features composed: DR streaming to a second cluster WHILE
+    the primary runs a Cycle load, takes an exclusion drain, and flips
+    redundancy — then failover, and the secondary serves the exact ring."""
+    from foundationdb_tpu.client import management as mgmt
+    from foundationdb_tpu.client.dr import DRAgent
+
+    buggify.disable()
+    primary = RecoverableCluster(
+        seed=1701, n_machines=6, n_dcs=2, n_storage_shards=2,
+        redundancy="double",
+    )
+    secondary = RecoverableCluster(seed=1702, loop=primary.loop)
+    db = primary.database()
+
+    async def main():
+        tr = db.create_transaction()
+        for i in range(8):
+            tr.set(b"ring/%d" % i, b"%d" % ((i + 1) % 8))
+        await tr.commit()
+
+        agent = DRAgent(primary, secondary)
+        await agent.start()
+
+        # load + exclusion + redundancy flip, all concurrent with DR
+        target = primary.storage[0].process.machine
+        await mgmt.exclude(db, [target])
+        await mgmt.configure(db, redundancy="triple")
+
+        for i in range(12):
+            async def rot(tr, i=i):
+                a = await tr.get(b"ring/%d" % (i % 8))
+                b_ = await tr.get(b"ring/" + a)
+                tr.set(b"ring/%d" % (i % 8), b_)
+                tr.set(b"ring/" + a, a)
+            await db.run(rot)
+
+        for _ in range(600):
+            await primary.loop.delay(0.1)
+            if (
+                mgmt.exclusion_safe(primary, [target])
+                and all(len(t) == 3 for t in primary.controller.storage_teams_tags)
+            ):
+                break
+        assert mgmt.exclusion_safe(primary, [target])
+        assert all(len(t) == 3 for t in primary.controller.storage_teams_tags)
+
+        await agent.failover(timeout=240.0)
+
+        # the secondary serves the exact ring the primary ended with
+        tr = db.create_transaction()
+        pri_ring = dict(await tr.get_range(b"ring/", b"ring0"))
+        tr2 = secondary.database().create_transaction()
+        sec_ring = dict(await tr2.get_range(b"ring/", b"ring0"))
+        assert sec_ring == pri_ring
+        # and the ring is still a permutation (no lost rotation)
+        vals = sorted(int(v) for v in sec_ring.values())
+        assert vals == sorted(int(k[5:]) for k in sec_ring)
+        return True
+
+    assert primary.run_until(primary.loop.spawn(main()), 900)
+    secondary.stop()
+    primary.stop()
