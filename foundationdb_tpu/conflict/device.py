@@ -83,6 +83,17 @@ BUCKET_BITS = 16
 N_BUCKETS = 1 << BUCKET_BITS
 FAST_SEARCH_ITERS = 11  # converges windows up to 1024 boundaries (2**(n-1))
 
+
+def _rec_search_iters() -> int:
+    """Bucketed-search depth for the LSM RECENT level (FDBTPU_REC_ITERS).
+    The recent level holds ~2^17 boundaries across 2^16 prefix buckets —
+    average depth ~2 — so far fewer rounds than FAST_SEARCH_ITERS converge
+    it; a too-shallow setting only costs the (tested) full-depth replay
+    fallback.  Default stays FAST_SEARCH_ITERS until measured on the chip."""
+    import os
+
+    return int(os.environ.get("FDBTPU_REC_ITERS", str(FAST_SEARCH_ITERS)))
+
 _IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort", "gather")}
 
 
@@ -934,6 +945,7 @@ class DeviceConflictSet(ConflictSet):
         self._lsm = (
             os.environ.get("FDBTPU_LSM", "") == "1" if lsm is None else lsm
         )
+        self._rec_iters = _rec_search_iters()
         self._max_key_bytes = max_key_bytes
         self._W = keymod.num_words(max_key_bytes)
         self._base = oldest_version
@@ -1160,6 +1172,7 @@ class DeviceConflictSet(ConflictSet):
                 self._dev_ok,
                 cap=self._cap, rec_cap=self._rec_cap,
                 n_txn=Bp, n_read=R, n_write=Wn,
+                rec_iters=min(self._rec_iters, _levels(self._rec_cap) + 1),
                 search_impl=self._search_impl, merge_impl=self._merge_impl,
             )
             self._rec_ks, self._rec_vs, self._rec_bidx = nrk, nrv, nrb
@@ -1171,7 +1184,7 @@ class DeviceConflictSet(ConflictSet):
             return verdict
 
         iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
-        rec_iters = min(FAST_SEARCH_ITERS, _levels(self._rec_cap) + 1)
+        rec_iters = min(self._rec_iters, _levels(self._rec_cap) + 1)
         while True:
             verdict, nrk, nrv, nrb, nrc, conv, _ok = _resolve_lsm_kernel(
                 self._ks, self._vs, self._tab, self._bidx, self._dev_count,
