@@ -88,11 +88,22 @@ def _rec_search_iters() -> int:
     """Bucketed-search depth for the LSM RECENT level (FDBTPU_REC_ITERS).
     The recent level holds ~2^17 boundaries across 2^16 prefix buckets —
     average depth ~2 — so far fewer rounds than FAST_SEARCH_ITERS converge
-    it; a too-shallow setting only costs the (tested) full-depth replay
-    fallback.  Default stays FAST_SEARCH_ITERS until measured on the chip."""
+    it.  A too-shallow setting costs the (tested) full-depth replay
+    fallback per affected batch in sync mode, and invalidates a pipelined
+    stream (check_pipelined raises; the caller replays through sync) —
+    a perf lever, never a correctness one.  Clamped to [1, 32]; malformed
+    values fail loudly at construction (the knob-parsing convention).
+    Default stays FAST_SEARCH_ITERS until measured on the chip."""
     import os
 
-    return int(os.environ.get("FDBTPU_REC_ITERS", str(FAST_SEARCH_ITERS)))
+    v = os.environ.get("FDBTPU_REC_ITERS", str(FAST_SEARCH_ITERS))
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"FDBTPU_REC_ITERS must be an integer, got {v!r}"
+        ) from None
+    return max(1, min(n, 32))
 
 _IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort", "gather")}
 

@@ -266,6 +266,9 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._lsm = (
             os.environ.get("FDBTPU_LSM", "") == "1" if lsm is None else lsm
         )
+        from ..conflict.device import _rec_search_iters
+
+        self._rec_iters = _rec_search_iters()
         self._rec_cap = recent_capacity
         self.compactions = 0
         n = mesh.devices.size
@@ -576,11 +579,10 @@ class ShardedDeviceConflictSet(ConflictSet):
             self.check_pipelined()
             if self._rec_counts_ub.max() + 2 * Wn > self._rec_cap:
                 self._compact()
-        from ..conflict.device import _rec_search_iters
-
         fast_iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
-        # FDBTPU_REC_ITERS applies here too (device/sharded knob parity)
-        rec_iters = min(_rec_search_iters(), _levels(self._rec_cap) + 1)
+        # FDBTPU_REC_ITERS applies here too (device/sharded knob parity;
+        # read once at construction, like DeviceConflictSet)
+        rec_iters = min(self._rec_iters, _levels(self._rec_cap) + 1)
 
         if not sync:
             fn = self._fn_lsm(Bp, R, Wn, fast_iters, rec_iters)

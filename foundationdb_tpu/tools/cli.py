@@ -115,6 +115,19 @@ class Cli:
                 f"{doc['proxy']['txns_conflicted']} conflicted, "
                 f"version {doc['proxy']['committed_version']}",
             ]
+            conf = doc["cluster"].get("configuration")
+            if conf is not None:
+                lines.append(
+                    f"config: {conf['coordinators']} coordinators, "
+                    f"teams {conf['team_sizes']}"
+                    + (", LOCKED" if conf["locked"] else "")
+                    + (f", excluded {conf['excluded']}" if conf["excluded"] else "")
+                    + (f", maintenance {conf['maintenance_zones']}"
+                       if conf["maintenance_zones"] else "")
+                )
+            fm = doc["cluster"].get("failure_monitor")
+            if fm is not None and fm["failed"]:
+                lines.append(f"failed addresses: {fm['failed']}")
             for i, r in enumerate(doc["resolvers"]):
                 lines.append(
                     f"resolver {i}: {r['txns']} txns, {r['conflicts']} conflicts"
