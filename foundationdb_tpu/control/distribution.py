@@ -60,7 +60,14 @@ class DataDistributor:
         self.moves = 0
         self.heals = 0
         self.shard_splits = 0
+        self.shard_merges = 0
         self.exclusion_drains = 0
+        # boundaries THIS distributor created by splitting: the only merge
+        # candidates — bootstrap shard boundaries are the cluster's
+        # configured topology and are never collapsed (conservative vs the
+        # reference, which merges any undersized pair; our tests and team
+        # conventions assume the configured shards exist)
+        self._split_boundaries: set[bytes] = set()
         self._moving = False
         self._seg_prev: tuple = (None, 0.0)  # write-rate differencing state
         self._metrics_tick = 0
@@ -614,6 +621,20 @@ class DataDistributor:
                     hot, key, reason = idx, k, why
                     break
             if hot is None:
+                # no split needed: consider a MERGE of adjacent tiny shards
+                # (shardMerger, DataDistributionTracker): combined size
+                # under the merge thresholds — a fraction of the split
+                # point, so merge and split cannot oscillate.  Only
+                # split-created boundaries are candidates.
+                for i in range(len(teams) - 1):
+                    if (
+                        bounds[i + 1] in self._split_boundaries
+                        and sizes[i] + sizes[i + 1] < self.knobs.DD_SHARD_MERGE_BYTES
+                        and counts[i] + counts[i + 1] < self.knobs.DD_SHARD_MERGE_KEYS
+                    ):
+                        await self._merge_shards(i)
+                        self._sizes = None  # boundary count changed
+                        break
                 continue
             if reason == "write_hot":
                 testcov("dd.split_write_hot")
@@ -628,11 +649,51 @@ class DataDistributor:
             moved = await self.move_range(key, e, list(teams[cold]))
             if moved:
                 self.shard_splits += 1
+                self._split_boundaries.add(key)
                 testcov("dd.shard_split")
                 cc.trace.trace(
                     "DDShardSplit", SplitKey=repr(key), From=hot, To=cold,
                     HotKeys=sizes[hot],
                 )
+
+    async def _merge_shards(self, i: int) -> bool:
+        """Collapse adjacent shards i and i+1 into one (the reference's
+        shardMerger): move the right shard onto the left's team with the
+        normal MoveKeys machinery, then drop the boundary at a drained
+        barrier.  Returns False (no harm done) if a concurrent move/
+        recovery invalidated the plan — the next tick reconsiders."""
+        cc = self.cc
+        bounds: list = [b""] + list(cc.storage_splits) + [None]
+        teams = [list(t) for t in cc.storage_teams_tags]
+        boundary = bounds[i + 1]
+        dest = list(teams[i])
+        if set(teams[i + 1]) != set(dest):
+            moved = await self.move_range(boundary, bounds[i + 2], dest)
+            if not moved:
+                return False
+        # re-read the live map: the move (or a racing operation) may have
+        # reshaped it — collapse only if the boundary still exists and both
+        # sides now share a team
+        splits = list(cc.storage_splits)
+        teams = [list(t) for t in cc.storage_teams_tags]
+        if boundary not in splits:
+            return False
+        j = splits.index(boundary)
+        if set(teams[j]) != set(teams[j + 1]):
+            return False
+        new_splits = splits[:j] + splits[j + 1:]
+        new_teams = teams[:j + 1] + teams[j + 2:]
+        vm = await cc.install_storage_assignment(new_splits, new_teams)
+        if vm is None:
+            return False
+        await cc.persist_key_servers(new_splits, new_teams)
+        self._split_boundaries.discard(boundary)
+        self.shard_merges += 1
+        testcov("dd.shard_merge")
+        cc.trace.trace(
+            "DDShardMerge", Boundary=repr(boundary), Shard=j, Boundary_v=vm
+        )
+        return True
 
     def _tag_serves_overlap(self, tag: str, begin: bytes, end: bytes | None) -> bool:
         """Does the CURRENT keyServers map route any of [begin, end) to tag?"""

@@ -84,6 +84,7 @@ def cluster_status(cluster) -> dict[str, Any]:
             "moves": dd.moves,
             "heals": dd.heals,
             "shard_splits": dd.shard_splits,
+            "shard_merges": dd.shard_merges,
             "shards": len(controller.storage_teams_tags),
             "exclusion_drains": dd.exclusion_drains,
         }
@@ -132,8 +133,8 @@ STATUS_SCHEMA: dict = {
         "processes": dict,
         "latest_events": dict,
         "data_distribution?": {
-            "moves": int, "heals": int, "shard_splits": int, "shards": int,
-            "exclusion_drains": int,
+            "moves": int, "heals": int, "shard_splits": int,
+            "shard_merges": int, "shards": int, "exclusion_drains": int,
         },
         "backup_running?": bool,
         "configuration?": {

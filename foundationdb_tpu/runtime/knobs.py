@@ -115,6 +115,11 @@ class CoreKnobs(Knobs):
         # shardSplitter's bandwidth half)
         self.init("DD_SHARD_SPLIT_BYTES", 10_000_000)
         self.init("DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC", 1_000_000)
+        # shardMerger (DataDistributionTracker): ADJACENT shards whose
+        # combined size is below the merge threshold collapse into one —
+        # a fraction of the split point so merge/split cannot oscillate
+        self.init("DD_SHARD_MERGE_BYTES", 1_000_000)
+        self.init("DD_SHARD_MERGE_KEYS", 10_000)
 
     @property
     def mvcc_window_versions(self) -> int:

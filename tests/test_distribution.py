@@ -305,3 +305,51 @@ def test_split_on_write_bandwidth():
     rows = _get_all(c, db, b"hot", b"hou")
     assert len(rows) == 4
     c.stop()
+
+
+def test_auto_shard_merge():
+    """shardMerger: after a split's data is deleted, the two tiny adjacent
+    shards collapse back into one (boundary dropped at a drained barrier)
+    with zero data loss."""
+    c = RecoverableCluster(seed=208, n_storage_shards=2, storage_replication=2,
+                           durable=False)
+    c.knobs.DD_SHARD_SPLIT_KEYS = 60
+    c.knobs.DD_SHARD_MERGE_KEYS = 20
+    c.knobs.DD_SHARD_MERGE_BYTES = 4000
+    db = c.database()
+    _put_many(c, db, 200)
+
+    async def main():
+        for _ in range(200):
+            if c.dd.shard_splits >= 1:
+                break
+            await c.loop.delay(0.2)
+        assert c.dd.shard_splits >= 1
+        n_shards_split = len(c.controller.storage_teams_tags)
+
+        # delete almost everything: the split shards are now tiny
+        async def wipe(tr):
+            tr.clear_range(b"k", b"l")
+        await db.run(wipe)
+        async def keep(tr):
+            for i in range(5):
+                tr.set(b"k%04d" % i, b"v%d" % i)
+        await db.run(keep)
+
+        for _ in range(400):
+            if c.dd.shard_merges >= 1:
+                break
+            await c.loop.delay(0.2)
+        assert c.dd.shard_merges >= 1
+        assert len(c.controller.storage_teams_tags) < n_shards_split
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"k", b"l", limit=1000)
+        assert [k for k, _v in rows] == [b"k%04d" % i for i in range(5)]
+        # writes still flow on the merged map
+        async def w(tr):
+            tr.set(b"post-merge", b"1")
+        await db.run(w)
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 900)
+    c.stop()
