@@ -631,6 +631,12 @@ class DataDistributor:
                         bounds[i + 1] in self._split_boundaries
                         and sizes[i] + sizes[i + 1] < self.knobs.DD_SHARD_MERGE_BYTES
                         and counts[i] + counts[i + 1] < self.knobs.DD_SHARD_MERGE_KEYS
+                        # bandwidth hysteresis: a write-hot tiny pair must
+                        # NOT merge, or it would re-split on the write_hot
+                        # trigger forever (the reference's shardMerger
+                        # consults bandwidth the same way)
+                        and wrates[i] + wrates[i + 1]
+                        < self.knobs.DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC / 2
                     ):
                         await self._merge_shards(i)
                         self._sizes = None  # boundary count changed
@@ -660,15 +666,25 @@ class DataDistributor:
         """Collapse adjacent shards i and i+1 into one (the reference's
         shardMerger): move the right shard onto the left's team with the
         normal MoveKeys machinery, then drop the boundary at a drained
-        barrier.  Returns False (no harm done) if a concurrent move/
-        recovery invalidated the plan — the next tick reconsiders."""
+        barrier.  Holds the _moving mutex END TO END — the collapse must
+        not interleave with a heal/exclusion installer.  Returns False
+        (no harm done) if a concurrent operation invalidated the plan."""
+        if self._moving:
+            return False
+        self._moving = True
+        try:
+            return await self._merge_shards_inner(i)
+        finally:
+            self._moving = False
+
+    async def _merge_shards_inner(self, i: int) -> bool:
         cc = self.cc
         bounds: list = [b""] + list(cc.storage_splits) + [None]
         teams = [list(t) for t in cc.storage_teams_tags]
         boundary = bounds[i + 1]
         dest = list(teams[i])
         if set(teams[i + 1]) != set(dest):
-            moved = await self.move_range(boundary, bounds[i + 2], dest)
+            moved = await self._move_range(boundary, bounds[i + 2], dest)
             if not moved:
                 return False
         # re-read the live map: the move (or a racing operation) may have
