@@ -238,3 +238,51 @@ def test_manual_throttle_caps_admission():
 
     assert c.run_until(c.loop.spawn(main()), 300)
     c.stop()
+
+
+def test_exclude_worker_mode_pipeline_moves():
+    """Worker-recruited pipeline: excluding a machine whose workers host
+    pipeline roles triggers a recovery that recruits on other machines;
+    include re-admits it for future recruitment."""
+    c = RecoverableCluster(
+        seed=516, n_machines=6, n_dcs=2, n_workers=8, n_storage_shards=1,
+        storage_replication=2,
+    )
+    db = c.database()
+
+    async def main():
+        gen = c.controller.generation
+        target = next(
+            p.machine for p in gen.processes if p.machine is not None
+        )
+        await mgmt.exclude(db, [target])
+        for _ in range(600):
+            await c.loop.delay(0.1)
+            gen = c.controller.generation
+            if (
+                gen is not None and not c.controller._recovering
+                and not any(
+                    c.controller.is_excluded(p) for p in gen.processes
+                )
+                and mgmt.exclusion_safe(c, [target])
+            ):
+                break
+        assert not any(c.controller.is_excluded(p) for p in gen.processes)
+        # commits flow on the re-recruited pipeline
+        async def w(tr):
+            tr.set(b"wk", b"1")
+        await db.run(w)
+
+        # include: the machine is recruitable again (no forced move back,
+        # just eligibility — verify the exclusion state cleared)
+        await mgmt.include(db, [target])
+        for _ in range(100):
+            await c.loop.delay(0.1)
+            if not c.controller.excluded_targets:
+                break
+        assert not c.controller.excluded_targets
+        assert (await mgmt.get_excluded(db)) == []
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 900)
+    c.stop()
