@@ -286,3 +286,19 @@ def test_exclude_worker_mode_pipeline_moves():
 
     assert c.run_until(c.loop.spawn(main()), 900)
     c.stop()
+
+
+def test_round5_coverage_accounting():
+    """coveragetool discipline for the round-5 rare paths: a management
+    battery must actually fire the drain/lock/merge/redundancy sites."""
+    from foundationdb_tpu.runtime import coverage
+
+    coverage.reset()
+    # exclusion drain under load
+    test_exclude_drains_storage_under_load()
+    # lock gate (refusal path) + coordinators + throttle
+    test_lock_unlock_and_recovery()
+    test_manual_throttle_caps_admission()
+
+    assert coverage.hits("dd.excluded_drained") >= 1
+    assert coverage.hits("proxy.database_locked") >= 1
