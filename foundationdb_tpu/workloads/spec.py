@@ -41,7 +41,10 @@ from .consistency import ConsistencyCheckWorkload
 from .cycle import CycleWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
+from .readwrite import ReadWriteWorkload
 from .serializability import SerializabilityWorkload
+from .swizzle import SwizzleWorkload
+from .write_during_read import WriteDuringReadWorkload
 
 # WorkloadFactory (workloads.h:55 registration): spec testName -> class
 WORKLOAD_FACTORY = {
@@ -54,6 +57,9 @@ WORKLOAD_FACTORY = {
     "Serializability": SerializabilityWorkload,
     "FuzzApi": FuzzApiWorkload,
     "ConfigureDatabase": ConfigureDatabaseWorkload,
+    "ReadWrite": ReadWriteWorkload,
+    "Swizzle": SwizzleWorkload,
+    "WriteDuringRead": WriteDuringReadWorkload,
 }
 
 # spec key -> RecoverableCluster kwarg
