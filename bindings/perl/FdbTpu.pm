@@ -1,0 +1,132 @@
+package FdbTpu;
+# Perl binding for foundationdb_tpu over the gateway wire protocol
+# (tools/gateway.py; the script-bindings slot of the reference's
+# bindings/ruby — a pure-socket client, no compiled library).
+#
+#   my $db = FdbTpu->new("127.0.0.1", $port);
+#   my $tid = $db->new_txn;
+#   $db->set($tid, "k", "v");
+#   $db->commit($tid);
+#
+# All methods die with "fdbtpu error <status>" on a non-zero status;
+# codes 1..5 are retryable (pass to on_error and re-run).
+use strict;
+use warnings;
+use IO::Socket::INET;
+
+sub new {
+    my ($class, $host, $port) = @_;
+    my $sock = IO::Socket::INET->new(
+        PeerAddr => $host, PeerPort => $port, Proto => 'tcp',
+    ) or die "connect $host:$port failed: $!";
+    binmode($sock);
+    return bless { sock => $sock, req => 0 }, $class;
+}
+
+sub _call {
+    my ($self, $op, $body) = @_;
+    $body //= '';
+    my $req = ++$self->{req};
+    my $payload = pack('Q<C', $req, $op) . $body;
+    my $frame = pack('V', length $payload) . $payload;
+    my $s = $self->{sock};
+    print {$s} $frame;
+    my $hdr = $self->_read(4);
+    my ($flen) = unpack('V', $hdr);
+    my $reply = $self->_read($flen);
+    my ($rid, $status) = unpack('Q<C', $reply);
+    die "fdbtpu protocol error: reply id $rid != $req" if $rid != $req;
+    die "fdbtpu error $status\n" if $status != 0;
+    return substr($reply, 9);
+}
+
+sub _read {
+    my ($self, $n) = @_;
+    my $buf = '';
+    while (length($buf) < $n) {
+        my $got = sysread($self->{sock}, my $chunk, $n - length($buf));
+        die "fdbtpu connection closed" unless $got;
+        $buf .= $chunk;
+    }
+    return $buf;
+}
+
+sub _wstr { my ($s) = @_; return pack('V', length $s) . $s; }
+
+sub protocol_version {
+    my ($self) = @_;
+    return unpack('V', $self->_call(12));
+}
+
+sub new_txn {
+    my ($self) = @_;
+    return unpack('Q<', $self->_call(1));
+}
+
+sub destroy_txn { my ($self, $t) = @_; $self->_call(2, pack('Q<', $t)); }
+sub reset_txn   { my ($self, $t) = @_; $self->_call(3, pack('Q<', $t)); }
+
+sub set {
+    my ($self, $t, $k, $v) = @_;
+    $self->_call(4, pack('Q<', $t) . _wstr($k) . _wstr($v));
+}
+
+sub clear_range {
+    my ($self, $t, $b, $e) = @_;
+    $self->_call(5, pack('Q<', $t) . _wstr($b) . _wstr($e));
+}
+
+sub get {
+    my ($self, $t, $k) = @_;
+    my $out = $self->_call(6, pack('Q<', $t) . _wstr($k));
+    my $present = unpack('C', $out);
+    my ($len) = unpack('V', substr($out, 1, 4));
+    return $present ? substr($out, 5, $len) : undef;
+}
+
+sub get_range {
+    my ($self, $t, $b, $e, $limit) = @_;
+    $limit //= 10000;
+    my $out = $self->_call(
+        7, pack('Q<', $t) . _wstr($b) . _wstr($e) . pack('V', $limit));
+    my ($n) = unpack('V', $out);
+    my $off = 4;
+    my @rows;
+    for (1 .. $n) {
+        my ($kl) = unpack('V', substr($out, $off, 4)); $off += 4;
+        my $k = substr($out, $off, $kl); $off += $kl;
+        my ($vl) = unpack('V', substr($out, $off, 4)); $off += 4;
+        my $v = substr($out, $off, $vl); $off += $vl;
+        push @rows, [$k, $v];
+    }
+    return \@rows;
+}
+
+sub atomic_add {
+    my ($self, $t, $k, $delta) = @_;
+    $self->_call(10, pack('Q<', $t) . _wstr($k) . pack('q<', $delta));
+}
+
+sub commit {
+    my ($self, $t) = @_;
+    return unpack('q<', $self->_call(8, pack('Q<', $t)));
+}
+
+sub on_error {
+    my ($self, $t, $code) = @_;
+    $self->_call(9, pack('Q<', $t) . pack('l<', $code));
+}
+
+sub set_option {
+    my ($self, $t, $opt) = @_;
+    $self->_call(13, pack('Q<', $t) . _wstr($opt));
+}
+
+sub get_read_version {
+    my ($self, $t) = @_;
+    return unpack('q<', $self->_call(11, pack('Q<', $t)));
+}
+
+sub close { my ($self) = @_; close($self->{sock}); }
+
+1;

@@ -1,0 +1,64 @@
+#!/usr/bin/perl
+# Perl side of the cross-binding stack-machine conformance tester
+# (bindings/bindingtester.py): reads {host, port, ops} as JSON on stdin
+# (byte fields base64), executes the SAME stack-machine semantics against
+# the gateway, and prints its digest as JSON on stdout.  Divergence from
+# another binding's digest = nonconformance.
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+use FdbTpu;
+use JSON::PP;
+use MIME::Base64 qw(decode_base64 encode_base64);
+
+my $input = do { local $/; <STDIN> };
+my $spec = JSON::PP->new->decode($input);
+my $db = FdbTpu->new($spec->{host}, $spec->{port});
+
+my @stack;
+my @log;
+my $NOT_PRESENT = 'RESULT_NOT_PRESENT';
+
+sub b64 { my ($s) = @_; my $e = encode_base64($s, ''); return $e; }
+
+my $t = $db->new_txn;
+for my $op (@{ $spec->{ops} }) {
+    my ($kind, @args) = @$op;
+    if ($kind eq 'PUSH') {
+        push @stack, decode_base64($args[0]);
+    } elsif ($kind eq 'DUP') {
+        push @stack, $stack[-1] if @stack;
+    } elsif ($kind eq 'SWAP') {
+        @stack[-1, -2] = @stack[-2, -1] if @stack >= 2;
+    } elsif ($kind eq 'SET') {
+        $db->set($t, decode_base64($args[0]), decode_base64($args[1]));
+    } elsif ($kind eq 'GET') {
+        my $v = $db->get($t, decode_base64($args[0]));
+        push @stack, defined($v) ? $v : $NOT_PRESENT;
+    } elsif ($kind eq 'CLEAR_RANGE') {
+        $db->clear_range($t, decode_base64($args[0]), decode_base64($args[1]));
+    } elsif ($kind eq 'GET_RANGE') {
+        my $rows = $db->get_range(
+            $t, decode_base64($args[0]), decode_base64($args[1]), $args[2]);
+        my $packed = join(';', map { $_->[0] . '=' . $_->[1] } @$rows);
+        push @stack, $packed;
+        push @log, ['range', $args[0], $args[1], $args[2], b64($packed)];
+    } elsif ($kind eq 'ATOMIC_ADD') {
+        $db->atomic_add($t, decode_base64($args[0]), $args[1]);
+    } elsif ($kind eq 'SET_OPTION') {
+        $db->set_option($t, decode_base64($args[0]));
+    } elsif ($kind eq 'GET_STACK_TOP') {
+        push @log, ['top', @stack ? b64($stack[-1]) : b64('EMPTY')];
+    } elsif ($kind eq 'COMMIT') {
+        $db->commit($t);
+        $t = $db->new_txn;
+    } elsif ($kind eq 'RESET') {
+        $db->reset_txn($t);
+    } else {
+        die "unknown op $kind";
+    }
+}
+$db->commit($t);
+push @log, ['stack', [map { b64($_) } @stack]];
+print JSON::PP->new->canonical->encode(\@log), "\n";

@@ -23,6 +23,13 @@ from bindingtester import digest  # noqa: E402
 
 SEEDS = [11, 12, 13]
 
+
+def _b64(x: bytes) -> str:
+    """THE wire encoding for byte fields in the Perl tester exchange."""
+    import base64
+
+    return base64.b64encode(x).decode()
+
 GATEWAY_SERVER = textwrap.dedent(
     """
     import sys
@@ -192,3 +199,71 @@ def test_three_bindings_conform(seed, clib):
     assert digests["gateway_py"] == digests["in_process"], (
         "gateway-python vs in-process divergence"
     )
+
+
+def _perlize(digest):
+    """Convert the Python digest to the Perl tester's wire form (byte
+    fields base64) for comparison."""
+    out = []
+    for e in digest:
+        if e[0] == "range":
+            out.append(["range", _b64(e[1]), _b64(e[2]), e[3], _b64(e[4])])
+        elif e[0] == "top":
+            out.append(["top", _b64(e[1])])
+        elif e[0] == "stack":
+            out.append(["stack", [_b64(x) for x in e[1]]])
+        else:
+            raise AssertionError(e)
+    return out
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_perl_binding_conforms(seed):
+    """The Perl binding (bindings/perl/FdbTpu.pm, pure sockets) executes
+    the same stack-machine spec via its own tester.pl and must produce the
+    same digest as the Python gateway client — the reference's
+    cross-LANGUAGE bindingtester comparison."""
+    import json
+
+    from bindingtester import gen_ops
+    from foundationdb_tpu.client.gateway_client import GatewayClient
+
+    b64 = _b64
+    ops = gen_ops(seed)
+    wire_ops = []
+    for op in ops:
+        kind = op[0]
+        if kind in ("PUSH", "GET", "SET_OPTION"):
+            wire_ops.append([kind, b64(op[1])])
+        elif kind in ("SET", "CLEAR_RANGE"):
+            wire_ops.append([kind, b64(op[1]), b64(op[2])])
+        elif kind == "GET_RANGE":
+            wire_ops.append([kind, b64(op[1]), b64(op[2]), op[3]])
+        elif kind == "ATOMIC_ADD":
+            wire_ops.append([kind, b64(op[1]), op[2]])
+        else:
+            wire_ops.append([kind])
+
+    # perl against its own fresh gateway cluster
+    proc1, port1 = _spawn_gateway(870 + seed)
+    try:
+        spec = json.dumps({"host": "127.0.0.1", "port": port1, "ops": wire_ops})
+        r = subprocess.run(
+            ["perl", str(REPO / "bindings" / "perl" / "tester.pl")],
+            input=spec, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, f"perl tester failed:\n{r.stderr[-2000:]}"
+        perl_digest = json.loads(r.stdout)
+    finally:
+        proc1.kill()
+
+    # python gateway client against another fresh cluster
+    proc2, port2 = _spawn_gateway(880 + seed)
+    try:
+        gc = GatewayClient("127.0.0.1", port2)
+        py_digest = _perlize(digest(_GatewayClientDriver(gc), seed))
+        gc.close()
+    finally:
+        proc2.kill()
+
+    assert perl_digest == py_digest, "perl vs python binding divergence"
