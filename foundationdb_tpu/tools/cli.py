@@ -38,6 +38,8 @@ commands:
   move <begin> <end> <shard>  MoveKeys: migrate a range to shard's team
   backup start <prefix>       continuous backup + snapshot into the cluster fs
   backup status | stop        backup progress / stop
+  dr start|status|switch|stop cluster-to-cluster DR to an embedded secondary
+                              (the fdbdr verbs; switch = drain + promote)
   errorcode <n>               name a numeric error code
   kill <process-name>         kill a process by name (recovery chaos)
   processes                   list processes
@@ -215,6 +217,42 @@ class Cli:
             if args[0] == "stop":
                 self._run(self._agent.stop())
                 return "backup stopped"
+        if cmd == "dr":
+            # dr start | dr status | dr switch | dr stop — the fdbdr tool
+            # verbs (fdbbackup/backup.actor.cpp dr role).  The secondary is
+            # an embedded cluster on the same loop; switch drains the
+            # stream to the primary's final commit and promotes it.
+            from ..client.dr import DRAgent
+            from ..control.recoverable import RecoverableCluster
+
+            if args[0] == "start":
+                if getattr(self, "_dr", None) is not None:
+                    return "dr already running"
+                self._dr_secondary = RecoverableCluster(
+                    seed=self.cluster.rng.random_int(1, 1 << 30),
+                    loop=c.loop,
+                )
+                self._dr = DRAgent(c, self._dr_secondary)
+                vm = self._run(self._dr.start())
+                return f"dr streaming from v{vm} (secondary locked)"
+            if args[0] == "status":
+                if getattr(self, "_dr", None) is None or self._dr.worker is None:
+                    return "no dr running"
+                return (
+                    f"dr applied to v{self._dr.worker.applied.get()}, "
+                    f"lag {self._dr.lag_versions} versions"
+                )
+            if args[0] == "switch":
+                final = self._run(self._dr.failover())
+                self._dr = None
+                return (
+                    f"switched: secondary exact at v{final}; "
+                    f"primary locked (use the secondary now)"
+                )
+            if args[0] == "stop":
+                self._run(self._dr.stop(unlock_secondary=True))
+                self._dr = None
+                return "dr stopped"
         if cmd == "errorcode":
             from ..roles.errors import error_name
 

@@ -69,3 +69,36 @@ def test_cli_move_backup_configure_errorcode():
     assert "n_tlogs" in out
     assert cli.one_command("errorcode 1020") == "not_committed"
     cli.cluster.stop()
+
+
+def test_cli_dr_verbs():
+    """fdbdr verbs: start streams to an embedded secondary, status reports
+    lag, switch drains and promotes (primary locked after)."""
+    from foundationdb_tpu.tools.cli import Cli
+
+    cli = Cli(seed=61)
+    assert "committed" in cli.one_command("set drk v1")
+    out = cli.one_command("dr start")
+    assert "dr streaming" in out
+    assert "committed" in cli.one_command("set drk2 v2")
+    assert "applied to" in cli.one_command("dr status")
+    out = cli.one_command("dr switch")
+    assert "switched" in out
+    # the secondary serves the exact data
+    c2 = cli._dr_secondary
+    db2 = c2.database()
+
+    async def check():
+        tr = db2.create_transaction()
+        return await tr.get(b"drk"), await tr.get(b"drk2")
+
+    v = cli.cluster.run_until(cli.cluster.loop.spawn(check()), 120)
+    assert v == (b"v1", b"v2")
+    # the deposed primary refuses writes
+    from foundationdb_tpu.roles.types import DatabaseLocked
+    import pytest
+
+    with pytest.raises(DatabaseLocked):
+        cli.one_command("set stale x")
+    cli.cluster.stop()
+    c2.stop()
