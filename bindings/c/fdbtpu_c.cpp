@@ -178,6 +178,23 @@ int fdbtpu_txn_set_option(FDBTPU_Database *db, uint64_t txn,
   return st;
 }
 
+int fdbtpu_txn_watch(FDBTPU_Database *db, uint64_t txn, const uint8_t *key,
+                     uint32_t key_len, int64_t *version) {
+  uint32_t blen = 8 + 4 + key_len;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_u32(b + 8, key_len);
+  memcpy(b + 12, key, key_len);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 14, b, blen, &out, &out_len);
+  free(b);
+  *version = 0;
+  if (st == 0 && out_len >= 8) *version = (int64_t)get_u64(out);
+  free(out);
+  return st;
+}
+
 int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
                           const uint8_t *key, uint32_t key_len, int64_t delta) {
   uint32_t blen = 8 + 4 + key_len + 8;

@@ -40,6 +40,12 @@ int fdbtpu_txn_clear_range(FDBTPU_Database *db, uint64_t txn,
 int fdbtpu_txn_set_option(FDBTPU_Database *db, uint64_t txn,
                           const uint8_t *option, uint32_t option_len);
 
+/* BLOCKS until key's value changes; returns the firing version
+ * (fdb_transaction_watch).  The handle runs one request at a time, so
+ * use a dedicated FDBTPU_Database for watches. */
+int fdbtpu_txn_watch(FDBTPU_Database *db, uint64_t txn, const uint8_t *key,
+                     uint32_t key_len, int64_t *version);
+
 int fdbtpu_txn_atomic_add(FDBTPU_Database *db, uint64_t txn,
                           const uint8_t *key, uint32_t key_len, int64_t delta);
 

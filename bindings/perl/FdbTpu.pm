@@ -127,6 +127,13 @@ sub get_read_version {
     return unpack('q<', $self->_call(11, pack('Q<', $t)));
 }
 
+# BLOCKS this connection until the key's value changes; returns the
+# firing version (use a dedicated FdbTpu connection for watches).
+sub watch {
+    my ($self, $t, $k) = @_;
+    return unpack('q<', $self->_call(14, pack('Q<', $t) . _wstr($k)));
+}
+
 sub close { my ($self) = @_; close($self->{sock}); }
 
 1;

@@ -117,6 +117,17 @@ class _Txn:
             )
         )
 
+    def watch(self, key: bytes) -> int:
+        """Blocks this handle until key's value changes; returns the
+        firing version (use a dedicated FdbTpu connection for watches)."""
+        version = ctypes.c_int64()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_watch(
+                self._db._h, self._tid, key, len(key), ctypes.byref(version)
+            )
+        )
+        return version.value
+
     def destroy(self) -> None:
         self._db._lib.fdbtpu_txn_destroy(self._db._h, self._tid)
 
@@ -142,6 +153,8 @@ class FdbTpu:
         lib.fdbtpu_txn_atomic_add.argtypes = [C.c_void_p, u64, C.c_char_p,
                                               u32, i64]
         lib.fdbtpu_txn_set_option.argtypes = [C.c_void_p, u64, C.c_char_p, u32]
+        lib.fdbtpu_txn_watch.argtypes = [C.c_void_p, u64, C.c_char_p, u32,
+                                         C.POINTER(i64)]
         lib.fdbtpu_txn_get.argtypes = [C.c_void_p, u64, C.c_char_p, u32,
                                        C.POINTER(C.c_int), C.POINTER(u8p),
                                        C.POINTER(u32)]

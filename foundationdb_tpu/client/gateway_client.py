@@ -99,6 +99,21 @@ class Transaction:
     def set_option(self, option: bytes) -> None:
         self._db._call(13, self._body(option))
 
+    def watch(self, key: bytes) -> int:
+        """BLOCKS this connection until `key`'s value changes; returns the
+        firing version.  Use a dedicated GatewayClient for watches — the
+        simple binding runs one request at a time.  The socket timeout is
+        suspended for the wait: a timeout mid-watch would desync the
+        request/reply stream (the late reply frame poisons the next call)."""
+        sock = self._db._sock
+        old = sock.gettimeout()
+        sock.settimeout(None)
+        try:
+            body = self._db._call(14, self._body(key))
+        finally:
+            sock.settimeout(old)
+        return struct.unpack_from("<q", body, 0)[0]
+
     def commit(self) -> int:
         body = self._db._call(8, self._body())
         return struct.unpack_from("<q", body, 0)[0]

@@ -28,6 +28,11 @@ Ops (body → reply body):
    11 GET_READ_VERSION u64                     → i64 version
    13 SET_OPTION   u64, option                 → ()   (transaction option by
                                                  name, e.g. lock_aware)
+   14 WATCH        u64, key                    → i64 version (replies when
+                                                 the key's value CHANGES —
+                                                 fdb_transaction_watch; use a
+                                                 dedicated connection, the
+                                                 simple bindings are serial)
 
 Status: 0 ok; 1 not_committed, 2 transaction_too_old, 3
 commit_unknown_result, 4 future_version, 5 timed_out, 6 bad request,
@@ -270,6 +275,21 @@ class ClientGateway:
                         tr.set_option(name)
                     except ValueError:
                         status = ERR_BAD_REQUEST
+                elif op == 14:  # WATCH (db-level: replies when key changes)
+                    k, off = _bstr(body, off)
+                    task = await self.db.watch(k)
+                    # reap on client disconnect: an abandoned watch must not
+                    # park a waiter task + storage registration forever (a
+                    # never-changing key would accumulate them unboundedly)
+                    while not task.done():
+                        if conn.closed:
+                            task.cancel()
+                            return
+                        from ..runtime.combinators import wait_any
+
+                        await wait_any([task, self.loop.delay(0.5)])
+                    ver = task.result()
+                    out += struct.pack("<q", ver)
                 else:
                     status = ERR_BAD_REQUEST
             self._reply(conn, req_id, status, bytes(out))

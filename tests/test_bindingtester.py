@@ -267,3 +267,76 @@ def test_perl_binding_conforms(seed):
         proc2.kill()
 
     assert perl_digest == py_digest, "perl vs python binding divergence"
+
+
+def test_watch_over_the_wire(clib):
+    """Op 14 WATCH through every binding: a dedicated watcher connection
+    blocks until another connection changes the key, and the returned
+    version is the firing commit's."""
+    import threading
+
+    from foundationdb_tpu.client.gateway_client import GatewayClient
+
+    sys.path.insert(0, str(REPO / "bindings" / "python"))
+    from fdbtpu_ctypes import FdbTpu
+
+    proc, port = _spawn_gateway(860)
+    try:
+        writer = GatewayClient("127.0.0.1", port)
+        writer.run(lambda tr: tr.set(b"w/k", b"v0"))
+
+        results = {}
+
+        def py_watch():
+            w = GatewayClient("127.0.0.1", port, timeout=60)
+            tr = w.transaction()
+            results["py"] = tr.watch(b"w/k")
+            w.close()
+
+        def c_watch():
+            db = FdbTpu(str(clib), "127.0.0.1", port)
+            tr = db.create_transaction()
+            results["c"] = tr.watch(b"w/k")
+            db.close()
+
+        def perl_watch():
+            r = subprocess.run(
+                ["perl", "-I", str(REPO / "bindings" / "perl"), "-MFdbTpu",
+                 "-e",
+                 f'my $db = FdbTpu->new("127.0.0.1", {port});'
+                 'my $t = $db->new_txn;'
+                 'print $db->watch($t, "w/k"), "\\n";'],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert r.returncode == 0, r.stderr
+            results["perl"] = int(r.stdout.strip())
+
+        threads = [threading.Thread(target=f)
+                   for f in (py_watch, c_watch, perl_watch)]
+        for t in threads:
+            t.start()
+        import time as _t
+
+        # fire REPEATEDLY with fresh values until every watcher returns: a
+        # late registrant (slow interpreter start) needs a change AFTER its
+        # registration, so a single timed write would be a race
+        commit_versions = []
+        for i in range(60):
+            tr = writer.transaction()
+            tr.set(b"w/k", b"v%d" % (i + 1))
+            commit_versions.append(tr.commit())
+            tr.destroy()
+            if all(not t.is_alive() for t in threads):
+                break
+            _t.sleep(0.5)
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "a watch never fired"
+        assert set(results) == {"py", "c", "perl"}
+        # the returned version is a real firing commit's: within the span
+        # of versions this test committed
+        for name, v in results.items():
+            assert commit_versions[0] <= v <= commit_versions[-1], (name, v)
+        writer.close()
+    finally:
+        proc.kill()
