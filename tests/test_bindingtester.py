@@ -340,3 +340,23 @@ def test_watch_over_the_wire(clib):
         writer.close()
     finally:
         proc.kill()
+
+
+def test_status_json_through_gateway():
+    """The special-key status document (\xff\xff/status/json) is readable
+    through the gateway GET op — every binding gets the status client for
+    free (fdbclient/StatusClient.actor.cpp's special-key fetch path)."""
+    import json
+
+    from foundationdb_tpu.client.gateway_client import GatewayClient
+
+    proc, port = _spawn_gateway(855)
+    try:
+        db = GatewayClient("127.0.0.1", port)
+        raw = db.read(lambda tr: tr.get(b"\xff\xff/status/json"))
+        doc = json.loads(raw)
+        assert doc["cluster"]["generation"]["state"] == "fully_recovered"
+        assert doc["cluster"]["configuration"]["team_sizes"] == [2, 2]
+        db.close()
+    finally:
+        proc.kill()
