@@ -480,6 +480,41 @@ class RecoverableCluster:
         )
         self.controller.stream_consumers[ROUTER_TAG] = self.log_router
 
+    def restart_log_router(self) -> None:
+        """Replace a dead log router with a fresh one on a new process —
+        the worker-restart path for the router role (a SimProcess reboot
+        comes back with EMPTY endpoints, so the role object must be
+        rebuilt and rewired, exactly like fdbmonitor restarting a worker).
+        The new router resumes the ROUTER tag from the TLogs' retained
+        backlog (nothing was popped while the old one was dark) and the
+        remote replicas re-point at its streams."""
+        from ..roles.logrouter import ROUTER_TAG, LogRouter
+        from ..roles.proxy import KeyPartitionMap
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        if self.log_router is not None:
+            self.log_router.stop()
+        splits = self._initial_storage_splits
+        remote_tags = [[s.tag] for s in self.remote_storage] or [
+            [f"remote-{i}-r0"] for i in range(len(splits) + 1)
+        ]
+        rproc = self.net.create_process(
+            f"log-router-{self.rng.random_unique_id()[:4]}"
+        )
+        self.log_router = LogRouter(
+            rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
+        )
+        cc = self.controller
+        cc.stream_consumers[ROUTER_TAG] = self.log_router
+        gen = cc.generation
+        if gen is not None:
+            cc._wire_stream_consumer(gen, ROUTER_TAG)
+        for ss in self.remote_storage:
+            ss.set_tlog_source(
+                _Ref(self.net, ss.process, self.log_router.peek_stream.endpoint),
+                _Ref(self.net, ss.process, self.log_router.pop_stream.endpoint),
+            )
+
     def _make_remote_storage(self, n_storage_shards: int, make_store) -> None:
         from ..rpc.stream import RequestStreamRef as _Ref
 
