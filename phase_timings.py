@@ -20,9 +20,7 @@ import bench as B
 
 
 def main() -> None:
-    from bench import _enable_compile_cache
-
-    _enable_compile_cache()  # the ~20 truncation compiles persist for reuse
+    B._enable_compile_cache()  # the ~20 truncation compiles persist for reuse
     import jax
     import jax.numpy as jnp
 
