@@ -95,6 +95,13 @@ int main(int argc, char **argv) {
           "get_range");
     CHECK(n_rows == 2, "range row count"); /* c/ctr, c/one */
     free(blob);
+
+    /* transaction options route end to end (lock_aware on an unlocked
+     * database is a no-op, an unknown option is refused) */
+    CHECK(fdbtpu_txn_set_option(db, txn, (const uint8_t *)"lock_aware", 10) == 0,
+          "set_option lock_aware");
+    CHECK(fdbtpu_txn_set_option(db, txn, (const uint8_t *)"bogus", 5) != 0,
+          "bogus option refused");
   }
   fdbtpu_txn_destroy(db, txn);
   fdbtpu_close(db);
