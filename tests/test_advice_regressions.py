@@ -289,3 +289,36 @@ class TestOverlayIndexedPaths:
                     assert ov.get(pk, probe_v, base.get) == model_get(pk, probe_v), (
                         f"divergence at key {pk} version {probe_v}"
                     )
+
+
+def test_unknown_result_fence_commits_through_locked_database():
+    """ADVICE r5 #1 regression: the unknown-result fence dummy is ALWAYS
+    lock-aware — a commit whose outcome is unknown must be fenceable even
+    if the database was locked between the commit and the retry (without
+    the fix, on_error raised DatabaseLocked and the fence never ran)."""
+    from foundationdb_tpu.client import management as mgmt
+    from foundationdb_tpu.control.recoverable import RecoverableCluster
+    from foundationdb_tpu.roles.types import CommitUnknownResult
+
+    c = RecoverableCluster(seed=570)
+    db = c.database()
+
+    async def main():
+        uid = await mgmt.lock_database(db)
+        for _ in range(100):
+            await c.loop.delay(0.1)
+            gen = c.controller.generation
+            if gen is not None and all(p.locked == uid for p in gen.proxies):
+                break
+        assert all(p.locked == uid for p in c.controller.generation.proxies)
+
+        # a NON-lock-aware transaction whose commit outcome is 'unknown':
+        # on_error must fence (commit a conflicting dummy) — through the lock
+        tr = db.create_transaction()
+        tr._read_ranges.append((b"fence/k", b"fence/l"))
+        tr._write_ranges.append((b"fence/k", b"fence/l"))
+        await tr.on_error(CommitUnknownResult())  # raises without the fix
+        return True
+
+    assert c.run_until(c.loop.spawn(main()), 300)
+    c.stop()
