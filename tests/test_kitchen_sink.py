@@ -255,3 +255,55 @@ def test_round5_feature_sink():
     assert primary.run_until(primary.loop.spawn(main()), 900)
     secondary.stop()
     primary.stop()
+
+
+def test_round5_feature_sink_chaos():
+    """The round-5 composition under CHAOS (buggify + randomized knobs):
+    DR streaming + exclusion drain + redundancy flip, then failover with
+    the secondary byte-exact.  One CI seed; soak more with the /tmp
+    campaign scripts (5 chaos seeds ran green in round 5)."""
+    from foundationdb_tpu.client import management as mgmt
+    from foundationdb_tpu.client.dr import DRAgent
+
+    buggify.disable()
+    primary = RecoverableCluster(
+        seed=9501, n_machines=6, n_dcs=2, n_storage_shards=2,
+        redundancy="double", chaos=True,
+    )
+    secondary = RecoverableCluster(seed=59501, loop=primary.loop)
+    db = primary.database()
+
+    async def main():
+        tr = db.create_transaction()
+        for i in range(8):
+            tr.set(b"r/%d" % i, b"%d" % ((i + 1) % 8))
+        await tr.commit()
+        agent = DRAgent(primary, secondary)
+        await agent.start()
+        target = primary.storage[0].process.machine
+        await mgmt.exclude(db, [target])
+        await mgmt.configure(db, redundancy="triple")
+        for i in range(10):
+            async def w(tr, i=i):
+                tr.set(b"w/%d" % i, b"x")
+            await db.run(w)
+        for _ in range(900):
+            await primary.loop.delay(0.1)
+            if (
+                mgmt.exclusion_safe(primary, [target])
+                and all(len(t) == 3 for t in primary.controller.storage_teams_tags)
+            ):
+                break
+        assert mgmt.exclusion_safe(primary, [target])
+        assert all(len(t) == 3 for t in primary.controller.storage_teams_tags)
+        await agent.failover(timeout=300.0)
+        tr = db.create_transaction()
+        pri = dict(await tr.get_range(b"", b"\xff", limit=100000))
+        tr2 = secondary.database().create_transaction()
+        sec = dict(await tr2.get_range(b"", b"\xff", limit=100000))
+        assert sec == pri
+        return True
+
+    assert primary.run_until(primary.loop.spawn(main()), 900)
+    secondary.stop()
+    primary.stop()
