@@ -257,7 +257,8 @@ def _init_backend(timeout_s: float, retries: int = 2) -> dict:
     return result
 
 
-def _emit(metric: str, value: float, vs_baseline: float, error: str | None = None) -> None:
+def _emit(metric: str, value: float, vs_baseline: float, error: str | None = None,
+          kernel: dict | None = None) -> None:
     doc = {
         "metric": metric,
         "value": round(value, 1),
@@ -266,6 +267,11 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
     }
     if error is not None:
         doc["error"] = error
+    if kernel is not None:
+        # kernel profiling counters (conflict/api.py KernelStats): the perf
+        # trajectory future rounds regress against — padding occupancy,
+        # bucket-induced recompiles, per-batch resolve-time percentiles
+        doc["kernel"] = kernel
     print(json.dumps(doc))
 
 
@@ -279,6 +285,9 @@ def main() -> None:
     versions = iter(range(1, 10_000))
     prefill = [gen_batch(rng, pool, next(versions)) for _ in range(PREFILL_BATCHES)]
     timed = [gen_batch(rng, pool, next(versions)) for _ in range(TIMED_BATCHES)]
+    # post-run batches: resolved SYNC one-by-one after the headline timing to
+    # put per-batch resolve-time percentiles into the kernel counters
+    post = [gen_batch(rng, pool, next(versions)) for _ in range(6)]
 
     total_checks = TIMED_BATCHES * TXNS_PER_BATCH * (READS_PER_TXN + 1)
 
@@ -319,7 +328,7 @@ def main() -> None:
         os._exit(0)  # daemon init thread may be wedged in PJRT; exit hard
     backend = init["backend"]
     try:
-        _device_run(backend, prefill, timed, pool_words, nat_verdicts,
+        _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
                     total_checks, native_s, native_rate)
     except SystemExit:
         raise
@@ -438,7 +447,7 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
     return si, mi, lsm
 
 
-def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
+def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
                 total_checks, native_s, native_rate) -> None:
     import jax
 
@@ -497,10 +506,34 @@ def _device_run(backend, prefill, timed, pool_words, nat_verdicts,
         raise SystemExit(f"abort-set parity FAILED in {mismatches} batches")
     print("[bench] abort-set parity OK", file=sys.stderr)
 
+    # ---------------- kernel counters (observability PR) ----------------
+    # a short SYNC pass: each batch's wall time is individually observable
+    # (the pipelined headline stream is not), giving honest p50/p99
+    sync_ms = []
+    for b in post:
+        args = device_pack(pool_words, b, _bucket)
+        t0 = time.perf_counter()
+        dev.resolve_arrays(b["version"], *args)
+        sync_ms.append((time.perf_counter() - t0) * 1e3)
+    snap = dev.kernel_stats()
+    kernel = {
+        "occupancy": round(snap["occupancy"], 4),
+        "recompiles": snap["recompiles"],
+        "search_fallbacks": snap["search_fallbacks"],
+        "compactions": snap["compactions"],
+        "node_count": snap["node_count"],
+        "abort_rate": round(snap["abort_rate"], 4),
+        "resolve_ms_p50": round(float(np.percentile(sync_ms, 50)), 2),
+        "resolve_ms_p99": round(float(np.percentile(sync_ms, 99)), 2),
+        "pipelined_ms_per_batch": round(device_s * 1e3 / len(timed), 2),
+    }
+    print(f"[bench] kernel counters: {kernel}", file=sys.stderr)
+
     _emit(
         f"occ_conflict_checks_per_sec_{backend}_64k_live_ranges",
         total_checks / device_s,
         native_s / device_s,
+        kernel=kernel,
     )
 
 

@@ -53,6 +53,74 @@ class TxInfo:
     write_ranges: Sequence[tuple[bytes, bytes]]
 
 
+@dataclasses.dataclass
+class KernelStats:
+    """Uniform conflict-backend cost counters (the device kernel's
+    profiling record, exposed by EVERY backend so parity checks can also
+    compare cost, and so the status roll-up reads one shape regardless of
+    which backend a resolver hosts).
+
+    Wall times are host-measured (time.perf_counter): they are observability
+    only and never feed back into simulation behavior, so determinism is
+    unaffected.  `pack_s` is TxInfo→tensor/ABI marshalling, `resolve_s` the
+    backend check itself, `merge_s` state maintenance outside the check
+    (device GC/compaction kernels; CPU removeBefore)."""
+
+    backend: str = "?"
+    batches: int = 0
+    txns: int = 0
+    aborted: int = 0            # CONFLICT verdicts
+    pack_s: float = 0.0
+    resolve_s: float = 0.0
+    merge_s: float = 0.0
+    real_rows: int = 0          # live read+write rows fed to the check
+    padded_rows: int = 0        # rows after power-of-two bucketing
+    recompiles: int = 0         # distinct static-shape combos jitted
+    search_fallbacks: int = 0   # bucketed search replayed at full depth
+    compactions: int = 0        # LSM recent→main folds
+    gc_calls: int = 0
+    rows_reclaimed: int = 0     # boundaries freed by GC/compaction
+
+    def __post_init__(self) -> None:
+        # per-batch resolve-time reservoir for p50/p99 (deterministic
+        # xorshift inside ContinuousSample — no global random use)
+        from ..runtime.metrics import ContinuousSample
+
+        self.resolve_sample = ContinuousSample(256)
+
+    def note_batch(self, n_txn: int, n_aborted: int, resolve_dt: float) -> None:
+        self.batches += 1
+        self.txns += n_txn
+        self.aborted += n_aborted
+        self.resolve_s += resolve_dt
+        self.resolve_sample.add(resolve_dt)
+
+    def snapshot(self, node_count: int = 0) -> dict:
+        return {
+            "backend": self.backend,
+            "batches": self.batches,
+            "txns": self.txns,
+            "aborted": self.aborted,
+            "abort_rate": self.aborted / self.txns if self.txns else 0.0,
+            "occupancy": (
+                self.real_rows / self.padded_rows if self.padded_rows else 1.0
+            ),
+            "rows_real": self.real_rows,
+            "rows_padded": self.padded_rows,
+            "recompiles": self.recompiles,
+            "search_fallbacks": self.search_fallbacks,
+            "compactions": self.compactions,
+            "gc_calls": self.gc_calls,
+            "rows_reclaimed": self.rows_reclaimed,
+            "node_count": node_count,
+            "pack_ms": self.pack_s * 1e3,
+            "resolve_ms": self.resolve_s * 1e3,
+            "merge_ms": self.merge_s * 1e3,
+            "resolve_ms_p50": self.resolve_sample.percentile(0.5) * 1e3,
+            "resolve_ms_p99": self.resolve_sample.percentile(0.99) * 1e3,
+        }
+
+
 class ConflictSet:
     """Abstract conflict set; implementations: oracle (conflict/oracle.py),
     native C++ (conflict/native.py), TPU (conflict/tpu.py)."""
@@ -70,6 +138,24 @@ class ConflictSet:
     @property
     def oldest_version(self) -> int:
         raise NotImplementedError
+
+    @property
+    def node_count(self) -> int:
+        """Live boundary/node count of the committed-write state (the
+        reference's skip-list node count); 0 where a backend can't say."""
+        return 0
+
+    def kernel_stats(self) -> dict:
+        """One-shape profiling snapshot (see KernelStats); backends that
+        never instrumented themselves report zeros rather than failing."""
+        stats = getattr(self, "stats", None)
+        if stats is None:
+            stats = self.stats = KernelStats(backend=type(self).__name__)
+        try:
+            nc = int(self.node_count)
+        except Exception:  # noqa: BLE001 — a closed plugin handle etc.
+            nc = 0
+        return stats.snapshot(node_count=nc)
 
     def close(self) -> None:  # destroyConflictSet analog
         pass

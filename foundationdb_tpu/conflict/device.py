@@ -53,6 +53,7 @@ bit-for-bit (simulation parity, SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import functools
+import time
 from typing import Sequence
 
 import jax
@@ -62,7 +63,7 @@ import numpy as np
 from .. import keys as keymod
 from ..ops.rmq import I32_MAX, _levels, build_sparse_table, query_sparse_table
 from ..ops.search import lex_less
-from .api import ConflictSet, TxInfo, Verdict, validate_batch
+from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
 from ..runtime.coverage import testcov
 
 _SENT_WORD = np.uint32(0xFFFFFFFF)
@@ -964,6 +965,11 @@ class DeviceConflictSet(ConflictSet):
         self._last_commit = oldest_version
         self._cap = capacity
         self._rec_cap = recent_capacity
+        # profiling counters (KernelStats): survive capacity regrows; the
+        # recompile count is the number of DISTINCT static-shape combos the
+        # jit cache has seen — the bucket-induced recompiles ISSUE cites
+        self.stats = KernelStats(backend="device")
+        self._compiled_shapes: set[tuple] = set()
         self._init_state(capacity)
 
     def _init_state(self, capacity: int, ks=None, vs=None, count: int = 1) -> None:
@@ -1026,6 +1032,39 @@ class DeviceConflictSet(ConflictSet):
             return self._count + int(self._rec_dev_count)
         return self._count
 
+    @property
+    def node_count(self) -> int:
+        """KernelStats name for the live state size (the skip-list
+        node-count analog).  NOTE: forces a device scalar fetch when a
+        pipelined stream has not been drained — a status scrape cost, not
+        a hot-path one."""
+        return self.boundary_count
+
+    def _note_shape(self, key: tuple) -> None:
+        if key not in self._compiled_shapes:
+            self._compiled_shapes.add(key)
+            self.stats.recompiles += 1
+
+    def _note_rows(self, rtv, wtv, R: int, Wn: int) -> None:
+        """Padded-vs-real occupancy, host arrays only: counting rows of a
+        device-resident array would force a sync mid-pipeline."""
+        if isinstance(rtv, np.ndarray) and isinstance(wtv, np.ndarray):
+            self.stats.real_rows += int((rtv >= 0).sum()) + int((wtv >= 0).sum())
+            self.stats.padded_rows += R + Wn
+
+    def _note_batch(self, t0: float, active_p, verdict_np) -> None:
+        """active_p/verdict_np must be HOST arrays or None: a pipelined
+        (device-resident) batch contributes timing only — counting its rows
+        would force a sync, and counting txns without verdicts would deflate
+        abort_rate — so txns/aborted accumulate only where verdicts are
+        host-observed and the ratio stays honest."""
+        if isinstance(active_p, np.ndarray) and verdict_np is not None:
+            n_txn = int(active_p.sum())
+            aborted = int(((verdict_np == int(Verdict.CONFLICT)) & active_p).sum())
+        else:
+            n_txn, aborted = 0, 0
+        self.stats.note_batch(n_txn, aborted, time.perf_counter() - t0)
+
     def _offset(self, version: int) -> int:
         off = version - self._base
         if off >= 2**31 - 2**24:
@@ -1046,9 +1085,11 @@ class DeviceConflictSet(ConflictSet):
             self._last_commit = commit_version
             return []
 
+        t_pack = time.perf_counter()
         rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
             txns, self._oldest, self._offset, self._max_key_bytes
         )
+        self.stats.pack_s += time.perf_counter() - t_pack
         codes = self.resolve_arrays(
             commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p
         )
@@ -1082,6 +1123,7 @@ class DeviceConflictSet(ConflictSet):
             )
         Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
         commit_off = np.int32(self._offset(commit_version))
+        t0 = time.perf_counter()
 
         if self._lsm:
             return self._resolve_arrays_lsm(
@@ -1103,6 +1145,10 @@ class DeviceConflictSet(ConflictSet):
                             snap_p, active_p, sync=True,
                         )
                     )
+            self._note_shape(
+                ("flat", self._cap, Bp, R, Wn, FAST_SEARCH_ITERS,
+                 self._merge_impl, self._search_impl)
+            )
             verdict, new_ks, new_vs, new_count, new_bidx, _conv, ok = _resolve_kernel(
                 self._ks, self._vs, self._bidx, self._dev_count,
                 rbv, rev, rtv, wbv, wev, wtv,
@@ -1119,6 +1165,8 @@ class DeviceConflictSet(ConflictSet):
             self._count_ub += 2 * Wn
             self._pipelined_since_check += 1
             self._last_commit = commit_version
+            self._note_rows(rtv, wtv, R, Wn)
+            self._note_batch(t0, active_p, None)  # dispatch time only
             return verdict
 
         while True:
@@ -1128,6 +1176,10 @@ class DeviceConflictSet(ConflictSet):
                 # ok_in as a device array so this shares ONE compiled
                 # executable with the pipelined path (a Python True traces
                 # as a weak-typed scalar => a second compile of the kernel)
+                self._note_shape(
+                    ("flat", self._cap, Bp, R, Wn, iters,
+                     self._merge_impl, self._search_impl)
+                )
                 verdict, new_ks, new_vs, new_count, new_bidx, conv, _ok = _resolve_kernel(
                     self._ks, self._vs, self._bidx, self._dev_count,
                     rbv, rev, rtv, wbv, wev, wtv,
@@ -1143,6 +1195,7 @@ class DeviceConflictSet(ConflictSet):
                 # shared-prefix keys): replay at full search depth — the
                 # kernel is pure, so the replay is exact
                 self.search_fallbacks += 1
+                self.stats.search_fallbacks += 1
                 testcov("kernel.search_fallback")
                 iters = _levels(self._cap) + 1
             new_count_i = int(new_count)
@@ -1160,13 +1213,19 @@ class DeviceConflictSet(ConflictSet):
                 max(self._cap * 2, _bucket(new_count_i)),
                 np.asarray(pre_ks), np.asarray(pre_vs), int(pre_dev_count),
             )
-        return np.asarray(verdict)
+        v_np = np.asarray(verdict)
+        self._note_rows(rtv, wtv, R, Wn)
+        self._note_batch(
+            t0, active_p, v_np if isinstance(active_p, np.ndarray) else None
+        )
+        return v_np
 
     # -- LSM paths -----------------------------------------------------------
     def _resolve_arrays_lsm(
         self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
         sync, Bp, R, Wn, commit_off,
     ):
+        t0 = time.perf_counter()
         # a single batch bigger than the recent level: grow recent first
         if 2 * Wn + 1 > self._rec_cap:
             self._grow_recent(_bucket(4 * Wn + 2))
@@ -1176,6 +1235,11 @@ class DeviceConflictSet(ConflictSet):
             self._compact()
 
         if not sync:
+            self._note_shape(
+                ("lsm", self._cap, self._rec_cap, Bp, R, Wn, FAST_SEARCH_ITERS,
+                 min(self._rec_iters, _levels(self._rec_cap) + 1),
+                 self._search_impl, self._merge_impl)
+            )
             verdict, nrk, nrv, nrb, nrc, _conv, ok = _resolve_lsm_kernel(
                 self._ks, self._vs, self._tab, self._bidx, self._dev_count,
                 self._rec_ks, self._rec_vs, self._rec_bidx, self._rec_dev_count,
@@ -1192,11 +1256,17 @@ class DeviceConflictSet(ConflictSet):
             self._rec_count_ub += 2 * Wn
             self._pipelined_since_check += 1
             self._last_commit = commit_version
+            self._note_rows(rtv, wtv, R, Wn)
+            self._note_batch(t0, active_p, None)  # dispatch time only
             return verdict
 
         iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
         rec_iters = min(self._rec_iters, _levels(self._rec_cap) + 1)
         while True:
+            self._note_shape(
+                ("lsm", self._cap, self._rec_cap, Bp, R, Wn, iters, rec_iters,
+                 self._search_impl, self._merge_impl)
+            )
             verdict, nrk, nrv, nrb, nrc, conv, _ok = _resolve_lsm_kernel(
                 self._ks, self._vs, self._tab, self._bidx, self._dev_count,
                 self._rec_ks, self._rec_vs, self._rec_bidx, self._rec_dev_count,
@@ -1210,6 +1280,7 @@ class DeviceConflictSet(ConflictSet):
             if bool(conv):
                 break
             self.search_fallbacks += 1
+            self.stats.search_fallbacks += 1
             testcov("kernel.search_fallback")
             iters = _levels(self._cap) + 1
             rec_iters = _levels(self._rec_cap) + 1
@@ -1227,10 +1298,17 @@ class DeviceConflictSet(ConflictSet):
         self._rec_dev_count = jnp.int32(nrc_i)
         self._rec_count_ub = nrc_i
         self._last_commit = commit_version
-        return np.asarray(verdict)
+        v_np = np.asarray(verdict)
+        self._note_rows(rtv, wtv, R, Wn)
+        self._note_batch(
+            t0, active_p, v_np if isinstance(active_p, np.ndarray) else None
+        )
+        return v_np
 
     def _compact(self) -> None:
         """Fold recent into main; regrow main if the union does not fit."""
+        t0 = time.perf_counter()
+        before = self._count_ub + self._rec_count_ub
         while True:
             nk, nv, nc, nb, nt = _compact_kernel(
                 self._ks, self._vs, self._rec_ks, self._rec_vs, cap=self._cap
@@ -1245,6 +1323,9 @@ class DeviceConflictSet(ConflictSet):
         self._dev_count = jnp.int32(nc_i)
         self._init_recent(self._rec_cap)
         self.compactions += 1
+        self.stats.compactions += 1
+        self.stats.rows_reclaimed += max(0, before - nc_i)
+        self.stats.merge_s += time.perf_counter() - t0
         testcov("kernel.lsm_compaction")
 
     def _grow_main(self, new_cap: int) -> None:
@@ -1306,6 +1387,7 @@ class DeviceConflictSet(ConflictSet):
         self._oldest = version
         off = version - self._base
         if off > 0:
+            t0 = time.perf_counter()
             if self._lsm:
                 self._vs, self._tab, self._rec_vs = _gc_lsm_kernel(
                     self._vs, self._tab, self._rec_vs, np.int32(off)
@@ -1313,3 +1395,5 @@ class DeviceConflictSet(ConflictSet):
             else:
                 self._ks, self._vs = _gc_kernel(self._ks, self._vs, np.int32(off))
             self._base = version
+            self.stats.gc_calls += 1
+            self.stats.merge_s += time.perf_counter() - t0

@@ -11,9 +11,10 @@ used by tests to referee the native and TPU implementations.
 from __future__ import annotations
 
 import bisect
+import time
 from typing import Sequence
 
-from .api import ConflictSet, TxInfo, Verdict, validate_batch
+from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
 
 
 class _StepFunction:
@@ -74,10 +75,15 @@ class OracleConflictSet(ConflictSet):
         self._history = _StepFunction()
         self._oldest = oldest_version
         self._last_commit = oldest_version
+        self.stats = KernelStats(backend="oracle")
 
     @property
     def oldest_version(self) -> int:
         return self._oldest
+
+    @property
+    def node_count(self) -> int:
+        return len(self._history._keys)
 
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
         validate_batch(commit_version, txns, self._oldest)
@@ -88,6 +94,7 @@ class OracleConflictSet(ConflictSet):
                 " reference masterserver.actor.cpp:831)"
             )
         self._last_commit = commit_version
+        t0 = time.perf_counter()
         verdicts: list[Verdict] = []
         batch_writes = _StepFunction()  # committed-so-far within this batch
         committed_writes: list[tuple[bytes, bytes]] = []
@@ -114,9 +121,22 @@ class OracleConflictSet(ConflictSet):
                 committed_writes.append((b, e))
         for b, e in committed_writes:
             self._history.assign(b, e, commit_version)
+        rows = sum(len(t.read_ranges) + len(t.write_ranges) for t in txns)
+        self.stats.real_rows += rows
+        self.stats.padded_rows += rows  # no padding in the oracle
+        self.stats.note_batch(
+            len(txns),
+            sum(1 for v in verdicts if v == Verdict.CONFLICT),
+            time.perf_counter() - t0,
+        )
         return verdicts
 
     def remove_before(self, version: int) -> None:
         if version > self._oldest:
             self._oldest = version
+            t0 = time.perf_counter()
+            before = len(self._history._keys)
             self._history.clamp_below(version)
+            self.stats.gc_calls += 1
+            self.stats.rows_reclaimed += max(0, before - len(self._history._keys))
+            self.stats.merge_s += time.perf_counter() - t0

@@ -11,11 +11,12 @@ device path intact whichever backend is loaded.
 from __future__ import annotations
 
 import ctypes
+import time
 from typing import Sequence
 
 import numpy as np
 
-from .api import ConflictSet, TxInfo, Verdict, validate_batch
+from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
 
 _ABI = {
     "fdbtpu_conflictset_backend_name": (ctypes.c_char_p, []),
@@ -70,6 +71,7 @@ class PluginConflictSet(ConflictSet):
         self._lib = lib
         self._handle = lib.fdbtpu_conflictset_create(oldest_version)
         self._oldest = oldest_version
+        self.stats = KernelStats(backend="native")
 
     @property
     def oldest_version(self) -> int:
@@ -78,6 +80,7 @@ class PluginConflictSet(ConflictSet):
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
         validate_batch(commit_version, txns, self._oldest)
         n = len(txns)
+        t_pack = time.perf_counter()
         snapshots = np.fromiter(
             (t.read_snapshot for t in txns), dtype=np.int64, count=n
         )
@@ -98,6 +101,7 @@ class PluginConflictSet(ConflictSet):
         key_bytes = np.frombuffer(b"".join(keys), dtype=np.uint8) if keys else np.zeros(0, np.uint8)
         offsets = np.zeros(len(keys) + 1, dtype=np.int64)
         np.cumsum([len(k) for k in keys], out=offsets[1:])
+        self.stats.pack_s += time.perf_counter() - t_pack
         verdicts = self.resolve_packed(
             commit_version, snapshots, n_reads, n_writes, key_bytes, offsets
         )
@@ -118,6 +122,7 @@ class PluginConflictSet(ConflictSet):
         the packed proxy->resolver wire format."""
         n = snapshots.shape[0]
         verdicts = np.zeros(max(n, 1), dtype=np.uint8)
+        t0 = time.perf_counter()
 
         def p(arr, ty):
             return arr.ctypes.data_as(ctypes.POINTER(ty))
@@ -137,12 +142,25 @@ class PluginConflictSet(ConflictSet):
             raise ValueError(
                 f"commit_version {commit_version} not after the previous batch"
             )
+        rows = (offsets.shape[0] - 1) // 2
+        self.stats.real_rows += rows
+        self.stats.padded_rows += rows  # the C ABI takes exact-size arrays
+        self.stats.note_batch(
+            n,
+            int((verdicts[:n] == int(Verdict.CONFLICT)).sum()),
+            time.perf_counter() - t0,
+        )
         return verdicts[:n]
 
     def remove_before(self, version: int) -> None:
         if version > self._oldest:
             self._oldest = version
+            t0 = time.perf_counter()
+            before = self.node_count
             self._lib.fdbtpu_conflictset_remove_before(self._handle, version)
+            self.stats.gc_calls += 1
+            self.stats.rows_reclaimed += max(0, before - self.node_count)
+            self.stats.merge_s += time.perf_counter() - t0
 
     @property
     def node_count(self) -> int:

@@ -53,6 +53,68 @@ from ..runtime.coverage import testcov
 from .logsystem import LogSystem
 
 
+def parse_conf_rows(rows) -> dict:
+    """Decode a `\\xff/conf/` range read into the configuration the
+    controller acts on — THE parser, shared by the live conf watcher and
+    the recovery-time re-read of the recovered system keyspace (a torn or
+    malformed row is skipped in both, never fatal)."""
+    from ..client.management import (
+        CONF_PREFIX,
+        COORDINATORS_KEY,
+        EXCLUDED_PREFIX,
+        LOCK_KEY,
+        MAINTENANCE_PREFIX,
+    )
+
+    conf: dict[str, int] = {}
+    excluded: set[str] = set()
+    locked: bytes | None = None
+    coord_n: int | None = None
+    maint: dict[str, float] = {}
+    redundancy: str | None = None
+    throttle: float | None = None
+    for k, v in rows:
+        if k.startswith(EXCLUDED_PREFIX):
+            excluded.add(k[len(EXCLUDED_PREFIX):].decode())
+            continue
+        if k == LOCK_KEY:
+            locked = v
+            continue
+        if k == COORDINATORS_KEY:
+            try:
+                coord_n = int(v)
+            except ValueError:
+                pass
+            continue
+        if k.startswith(MAINTENANCE_PREFIX):
+            try:
+                maint[k[len(MAINTENANCE_PREFIX):].decode()] = float(v)
+            except (ValueError, UnicodeDecodeError):
+                pass
+            continue
+        if k == CONF_PREFIX + b"redundancy":
+            try:
+                redundancy = v.decode()
+            except UnicodeDecodeError:
+                pass
+            continue
+        if k == CONF_PREFIX + b"throttle_tps":
+            try:
+                throttle = float(v)
+            except ValueError:
+                pass
+            continue
+        try:
+            conf[k[len(CONF_PREFIX):].decode()] = int(v)
+        except (ValueError, UnicodeDecodeError):
+            continue  # a malformed conf row must not kill the caller
+    return {
+        "conf": conf, "excluded": excluded, "locked": locked,
+        "coord_n": coord_n, "maint": maint, "redundancy": redundancy,
+        "throttle": throttle,
+    }
+
+
 class RecoveryState:
     """Reference fdbserver/RecoveryState.h:30 names."""
 
@@ -304,6 +366,18 @@ class ClusterController:
             else:
                 recovery_version, tag_data = await self._lock_old_tlogs(old)
 
+            if first:
+                # Re-learn the database lock / exclusions / maintenance from
+                # the recovered system keyspace (`\xff/conf/` in durable
+                # storage, plus the committed-but-unflushed suffix surviving
+                # in the TLog seeds) BEFORE recruiting (exclusions steer
+                # placement) and before ACCEPTING_COMMITS: a restarted
+                # locked cluster must not accept a single non-lock-aware
+                # commit in the window before the first conf-poll tick
+                # (ADVICE round 5).  Mid-life recoveries keep the in-memory
+                # state, which the conf watch holds current.
+                self._recover_conf_from_storage(tag_data)
+
             # RECRUITING: fresh pipeline on fresh processes (or, in worker
             # mode, recruited onto surviving workers)
             self._set_state(RecoveryState.RECRUITING)
@@ -376,6 +450,85 @@ class ClusterController:
         finally:
             self._recovering = False
 
+    def _read_conf_rows_from_storage(self) -> list[tuple[bytes, bytes]]:
+        """Direct host-side read of the `\\xff/conf/` range from the storage
+        team that owns it (the txnStateStore-recovery analog: the reference
+        master reloads configuration from the recovered txn state store
+        before accepting commits).  Best-effort: an unreachable team means
+        the conf watch corrects state one poll later, as before."""
+        from ..client.management import CONF_PREFIX
+
+        begin, end = CONF_PREFIX, CONF_PREFIX + b"\xff"
+        try:
+            team = self._storage_teams()[-1]  # `\xff` sorts into the last shard
+        except Exception:  # noqa: BLE001 — malformed team map: skip
+            return []
+        for ss in team:
+            if not ss.process.alive:
+                continue
+            try:
+                base = {k: v for k, v in ss.store.range_read(begin, end, 10_000)}
+                keys = set(base) | set(ss.overlay.overlay_keys_in(begin, end))
+                rows = []
+                for k in sorted(keys):
+                    v = ss.overlay.get(k, ss.version.get(), ss.store.get)
+                    if v is not None:
+                        rows.append((k, v))
+                return rows
+            except Exception:  # noqa: BLE001 — mid-reboot store: next replica
+                continue
+        return []
+
+    def _recover_conf_from_storage(self, tlog_seeds: list[dict] | None = None) -> None:
+        rows = dict(self._read_conf_rows_from_storage())
+        # the durable store lags commits by the MVCC window: fold the
+        # committed-but-unflushed conf mutations surviving in the recovered
+        # TLog seeds on top, in version order — together they ARE the
+        # recovered system keyspace
+        if tlog_seeds:
+            from ..client.management import CONF_PREFIX
+            from ..roles.types import MutationType
+
+            team_tags = set(self.storage_teams_tags[-1])
+            by_version: dict[Version, list] = {}
+            for slot in tlog_seeds:
+                for tag, entries in slot.items():
+                    if tag in team_tags:
+                        for v, muts in entries:
+                            by_version[v] = muts  # replica copies are identical
+            hi = CONF_PREFIX + b"\xff"
+            for v in sorted(by_version):
+                for m in by_version[v]:
+                    if m.type == MutationType.CLEAR_RANGE:
+                        if m.key < hi and m.value > CONF_PREFIX:
+                            for k in [
+                                k for k in rows if m.key <= k < m.value
+                            ]:
+                                del rows[k]
+                    elif (
+                        m.type == MutationType.SET_VALUE
+                        and m.key.startswith(CONF_PREFIX)
+                    ):
+                        rows[m.key] = m.value
+        rows = sorted(rows.items())
+        if not rows:
+            return
+        parsed = parse_conf_rows(rows)
+        self._locked = parsed["locked"]
+        if parsed["excluded"]:
+            self.excluded_targets = set(parsed["excluded"])
+        now = self.loop.now()
+        self.maintenance_zones = {
+            z: d for z, d in parsed["maint"].items() if d > now
+        }
+        if self.ratekeeper is not None:
+            self.ratekeeper.manual_tps_cap = parsed["throttle"]
+        self.trace.trace(
+            "ConfigurationRecovered", Epoch=self.epoch,
+            Locked=self._locked is not None,
+            Excluded=sorted(self.excluded_targets),
+        )
+
     def _keep_tag(self, tag: str) -> bool:
         """Seed filter for the next epoch: a stream-consumer tag (backup
         worker / log router / DR) is re-seeded only while its consumer is
@@ -392,9 +545,13 @@ class ClusterController:
         if old is None:
             return 0, [dict() for _ in range(self.n_tlogs)]
         ls = old.log_system or LogSystem(old.epoch, old.tlogs, old.tlog_paths)
+        # required_tags unconditionally: a MEMORY-engine cluster has no disk
+        # fallback, so losing every replica slot of a storage tag is exactly
+        # as unrecoverable as on disk — recovery must refuse loudly instead
+        # of silently dropping the tag's unpopped data (ADVICE round 5)
         recovery_version, replies = await ls.lock(
             self.net, self._cc_proc(), self.fs,
-            required_tags=[s.tag for s in self.storage] if self.fs is not None else [],
+            required_tags=[s.tag for s in self.storage],
         )
         seeds = LogSystem.merge_replies(
             replies, recovery_version, self.n_tlogs, self._keep_tag
@@ -1146,13 +1303,7 @@ class ClusterController:
         reference's master reacts to txnStateStore config-key changes the
         same way (ManagementAPI.actor.cpp changeConfig; masterserver
         restarts on configuration version bump)."""
-        from ..client.management import (
-            CONF_PREFIX,
-            COORDINATORS_KEY,
-            EXCLUDED_PREFIX,
-            LOCK_KEY,
-            MAINTENANCE_PREFIX,
-        )
+        from ..client.management import CONF_PREFIX
 
         view = None
         while True:
@@ -1169,48 +1320,14 @@ class ClusterController:
                 rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
             except Exception:  # noqa: BLE001 — recovery window; retry next tick
                 continue
-            conf = {}
-            excluded: set[str] = set()
-            locked: bytes | None = None
-            coord_n: int | None = None
-            maint: dict[str, float] = {}
-            redundancy: str | None = None
-            throttle: float | None = None
-            for k, v in rows:
-                if k.startswith(EXCLUDED_PREFIX):
-                    excluded.add(k[len(EXCLUDED_PREFIX):].decode())
-                    continue
-                if k == LOCK_KEY:
-                    locked = v
-                    continue
-                if k == COORDINATORS_KEY:
-                    try:
-                        coord_n = int(v)
-                    except ValueError:
-                        pass
-                    continue
-                if k.startswith(MAINTENANCE_PREFIX):
-                    try:
-                        maint[k[len(MAINTENANCE_PREFIX):].decode()] = float(v)
-                    except (ValueError, UnicodeDecodeError):
-                        pass
-                    continue
-                if k == CONF_PREFIX + b"redundancy":
-                    try:
-                        redundancy = v.decode()
-                    except UnicodeDecodeError:
-                        pass
-                    continue
-                if k == CONF_PREFIX + b"throttle_tps":
-                    try:
-                        throttle = float(v)
-                    except ValueError:
-                        pass
-                    continue
-                try:
-                    conf[k[len(CONF_PREFIX):].decode()] = int(v)
-                except (ValueError, UnicodeDecodeError):
-                    continue  # a malformed conf row must not kill the watcher
+            parsed = parse_conf_rows(rows)
+            conf = parsed["conf"]
+            excluded = parsed["excluded"]
+            locked = parsed["locked"]
+            coord_n = parsed["coord_n"]
+            maint = parsed["maint"]
+            redundancy = parsed["redundancy"]
+            throttle = parsed["throttle"]
             # compare DESIRED against the ACTUAL generation — never against
             # fields mutated by a previous (possibly failed) attempt, or a
             # committed reconfiguration could be dropped forever

@@ -19,6 +19,7 @@ from ..roles.storage import StorageServer
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.knobs import CoreKnobs
 from ..runtime.metrics import Smoother
+from ..runtime.trace import SEV_INFO, SEV_WARN
 
 
 class Ratekeeper:
@@ -29,12 +30,15 @@ class Ratekeeper:
         storage: list[StorageServer],
         tlogs_fn,  # callable -> current list[TLog] (generation changes)
         max_tps: float = 1e6,
+        trace=None,  # TraceCollector: RkUpdate track_latest events feed the
+                     # status messages roll-up (the reference's RkUpdate)
     ) -> None:
         self.loop = loop
         self.knobs = knobs
         self.storage = storage
         self.tlogs_fn = tlogs_fn
         self.max_tps = max_tps
+        self.trace = trace
         self.tps_budget = max_tps
         self.batch_tps_budget = max_tps
         # operator-imposed cap (fdbcli `throttle`, `\xff/conf/throttle_tps`):
@@ -122,6 +126,17 @@ class Ratekeeper:
         self.batch_tps_budget = max(
             0.0, (self.tps_budget - 0.25 * self.max_tps) / 0.75
         )
+        if self.trace is not None and reason != self.limit_reason:
+            # only on TRANSITIONS (not every 0.25s tick): the latest event
+            # is what status scrapes; WARN while limited makes it a message
+            self.trace.trace(
+                "RkUpdate",
+                severity=SEV_WARN if reason != "unlimited" else SEV_INFO,
+                track_latest="ratekeeper",
+                Reason=reason,
+                LimitingServer=limiting,
+                TPSBudget=round(self.tps_budget, 1),
+            )
         self.limit_reason = reason
         self.limiting_server = limiting
 

@@ -345,6 +345,7 @@ class RecoverableCluster:
             tlogs_fn=lambda: (
                 self.controller.generation.tlogs if self.controller.generation else []
             ),
+            trace=self.trace,
         )
         self.controller.ratekeeper = self.ratekeeper
         # generation 1 was recruited before the ratekeeper existed
@@ -631,9 +632,20 @@ class RecoverableCluster:
 
             return json.dumps(cluster_status(self), default=str).encode()
 
+        def _timeline_json() -> bytes:
+            import json
+
+            from ..tools.timeline import timeline_dump
+
+            return json.dumps(timeline_dump(), default=str).encode()
+
         # special key space handlers (SpecialKeySpace.actor.cpp): the
-        # status-client path reads \xff\xff/status/json like any key
-        view.special_keys = {b"\xff\xff/status/json": _status_json}
+        # status-client path reads \xff\xff/status/json like any key; the
+        # timeline key scrapes every sampled transaction's station journey
+        view.special_keys = {
+            b"\xff\xff/status/json": _status_json,
+            b"\xff\xff/timeline/json": _timeline_json,
+        }
 
         # range modules — the readable SystemData vocabulary
         # (fdbclient/SystemData.cpp keyServersPrefix / excludedServersPrefix

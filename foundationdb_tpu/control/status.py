@@ -9,6 +9,81 @@ from __future__ import annotations
 
 from typing import Any
 
+from ..runtime.metrics import LatencyTracker
+from ..runtime.trace import SEV_WARN
+
+
+def _severity_name(sev: int) -> str:
+    return {5: "debug", 10: "info", 20: "warn", 30: "warn_always"}.get(
+        sev, "error"
+    )
+
+
+def _messages(trace, ratekeeper) -> list[dict[str, Any]]:
+    """Operator-facing message list (the reference status doc's
+    cluster.messages): every SEV_WARN+ `track_latest` snapshot becomes a
+    message, plus the ratekeeper's live limiting reason — the two channels
+    that say WHY a cluster is degraded rather than just that it is."""
+    msgs: list[dict[str, Any]] = []
+    for key, ev in sorted(trace.latest.items()):
+        if ev.get("Severity", 0) >= SEV_WARN:
+            msgs.append({
+                "name": ev["Type"],
+                "severity": _severity_name(ev["Severity"]),
+                "time": ev.get("Time", 0.0),
+                "description": ", ".join(
+                    f"{k}={v}" for k, v in ev.items()
+                    if k not in ("Type", "Severity", "Time")
+                ),
+            })
+    if ratekeeper is not None and ratekeeper.limit_reason != "unlimited":
+        msgs.append({
+            "name": "performance_limited",
+            "severity": "warn",
+            "time": None,
+            "description": (
+                f"admission limited by {ratekeeper.limit_reason}"
+                + (
+                    f" on {ratekeeper.limiting_server}"
+                    if ratekeeper.limiting_server else ""
+                )
+                + f" (tps_budget {ratekeeper.tps_budget:.0f})"
+            ),
+        })
+    return msgs
+
+
+def _kernel_rollup(resolvers) -> dict[str, Any]:
+    """Aggregate the resolvers' conflict-backend KernelStats into one
+    cluster-level view (counters sum; occupancy re-derives from the summed
+    row counts; resolve-time percentiles take the worst resolver — the one
+    that paces the commit pipeline's barrier)."""
+    per = [r.cs.kernel_stats() for r in resolvers]
+    if not per:
+        from ..conflict.api import KernelStats
+
+        return {
+            **KernelStats(backend="none").snapshot(),
+            "per_resolver": [],
+        }
+    out: dict[str, Any] = {
+        "backend": per[0]["backend"],
+        "per_resolver": per,
+    }
+    for k in (
+        "batches", "txns", "aborted", "rows_real", "rows_padded",
+        "recompiles", "search_fallbacks", "compactions", "gc_calls",
+        "rows_reclaimed", "node_count", "pack_ms", "resolve_ms", "merge_ms",
+    ):
+        out[k] = sum(p[k] for p in per)
+    out["abort_rate"] = out["aborted"] / out["txns"] if out["txns"] else 0.0
+    out["occupancy"] = (
+        out["rows_real"] / out["rows_padded"] if out["rows_padded"] else 1.0
+    )
+    for k in ("resolve_ms_p50", "resolve_ms_p99"):
+        out[k] = max(p[k] for p in per)
+    return out
+
 
 def cluster_status(cluster) -> dict[str, Any]:
     """Works on SimCluster (static generation) and RecoverableCluster."""
@@ -18,6 +93,7 @@ def cluster_status(cluster) -> dict[str, Any]:
     if controller is not None:
         gen = controller.generation
         proxy = gen.proxy
+        proxies = gen.proxies
         resolvers = gen.resolvers
         tlogs = gen.tlogs
         epoch = controller.epoch
@@ -28,6 +104,7 @@ def cluster_status(cluster) -> dict[str, Any]:
         }
     else:
         proxy = cluster.proxy
+        proxies = [cluster.proxy]
         resolvers = cluster.resolvers
         tlogs = cluster.tlogs
         recovery = {"state": "accepting_commits", "epoch": 1, "count": 0}
@@ -54,6 +131,7 @@ def cluster_status(cluster) -> dict[str, Any]:
                 **r.counters.snapshot(),
                 "version": r.version.get(),
                 "oldest_version": r.cs.oldest_version,
+                "latency": r.latency.snapshot(),
             }
             for r in resolvers
         ],
@@ -68,6 +146,7 @@ def cluster_status(cluster) -> dict[str, Any]:
                 "version": ss.version.get(),
                 "durable_version": ss.durable_version,
                 "keys": ss.store.key_count(),
+                "read_latency": ss.read_latency.snapshot(),
                 # ssd engine only: page-cache accounting (AsyncFileCached)
                 **(
                     {"cache_hits": ss.store.cache_hits,
@@ -78,6 +157,29 @@ def cluster_status(cluster) -> dict[str, Any]:
             for ss in cluster.storage
         ],
     }
+    # -- latency bands + per-stage histograms (tentpole seam 1) -------------
+    # commit/GRV merge across ALL proxies (each proxy owns its trackers);
+    # the stage histograms say where inside commitBatch the time goes
+    doc["latency_bands"] = {
+        "commit": LatencyTracker.merged([p.latency["commit"] for p in proxies]),
+        "grv": LatencyTracker.merged([p.latency["grv"] for p in proxies]),
+        "stages": {
+            stage: LatencyTracker.merged([p.latency[stage] for p in proxies])
+            for stage in ("batch_wait", "version_assign", "resolution",
+                          "tlog_push")
+        },
+        "resolver": LatencyTracker.merged([r.latency for r in resolvers]),
+        "storage_read": LatencyTracker.merged(
+            [ss.read_latency for ss in cluster.storage]
+        ),
+    }
+
+    # -- conflict-kernel profiling counters (tentpole seam 2) ---------------
+    doc["kernel"] = _kernel_rollup(resolvers)
+
+    rk = getattr(cluster, "ratekeeper", None)
+    doc["cluster"]["messages"] = _messages(trace, rk)
+
     dd = getattr(cluster, "dd", None)
     if dd is not None:
         doc["cluster"]["data_distribution"] = {
@@ -107,7 +209,6 @@ def cluster_status(cluster) -> dict[str, Any]:
             "transitions": fm.transitions,
         }
         doc["cluster"]["stream_consumers"] = sorted(controller.stream_consumers)
-    rk = getattr(cluster, "ratekeeper", None)
     if rk is not None:
         doc["ratekeeper"] = rk.status()
     if loop.profile:
@@ -124,6 +225,16 @@ def cluster_status(cluster) -> dict[str, Any]:
 # recursed), a [spec] (list, every element validated), or a tuple of
 # accepted types.  Optional keys are suffixed '?'.
 
+_LATENCY_SPEC: dict = {
+    "count": int,
+    "mean": (int, float),
+    "max": (int, float),
+    "p50": (int, float),
+    "p95": (int, float),
+    "p99": (int, float),
+    "bands": dict,
+}
+
 STATUS_SCHEMA: dict = {
     "cluster": {
         "generation": {"state": str, "epoch": int, "count": int},
@@ -132,6 +243,9 @@ STATUS_SCHEMA: dict = {
         "messages_dropped": int,
         "processes": dict,
         "latest_events": dict,
+        "messages": [
+            {"name": str, "severity": str, "description": str}
+        ],
         "data_distribution?": {
             "moves": int, "heals": int, "shard_splits": int,
             "shard_merges": int, "shards": int, "exclusion_drains": int,
@@ -158,13 +272,44 @@ STATUS_SCHEMA: dict = {
         "commit_batches": int,
         "mvcc_window_throttles": int,
     },
-    "resolvers": [{"version": int, "oldest_version": int}],
+    "resolvers": [
+        {"version": int, "oldest_version": int, "latency": _LATENCY_SPEC}
+    ],
     "tlogs": [
         {"version": int, "bytes_queued": int, "locked": bool, "spill_events": int}
     ],
     "storage": [
-        {"tag": str, "version": int, "durable_version": int, "keys": int}
+        {"tag": str, "version": int, "durable_version": int, "keys": int,
+         "read_latency": _LATENCY_SPEC}
     ],
+    "latency_bands": {
+        "commit": _LATENCY_SPEC,
+        "grv": _LATENCY_SPEC,
+        "stages": {
+            "batch_wait": _LATENCY_SPEC,
+            "version_assign": _LATENCY_SPEC,
+            "resolution": _LATENCY_SPEC,
+            "tlog_push": _LATENCY_SPEC,
+        },
+        "resolver": _LATENCY_SPEC,
+        "storage_read": _LATENCY_SPEC,
+    },
+    "kernel": {
+        "backend": str,
+        "batches": int,
+        "txns": int,
+        "abort_rate": (int, float),
+        "occupancy": (int, float),
+        "recompiles": int,
+        "search_fallbacks": int,
+        "compactions": int,
+        "gc_calls": int,
+        "rows_reclaimed": int,
+        "node_count": int,
+        "resolve_ms_p50": (int, float),
+        "resolve_ms_p99": (int, float),
+        "per_resolver": list,
+    },
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
     "ratekeeper?": {
         "tps_budget": (int, float),

@@ -37,6 +37,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+if not hasattr(jax, "shard_map"):  # pre-0.4.35 jax: not yet promoted out of
+    from jax.experimental.shard_map import shard_map as _exp_shard_map  # experimental
+
+    def _shard_map(f, *args, **kw):
+        if "check_vma" in kw:  # the kwarg's pre-promotion name
+            kw["check_rep"] = kw.pop("check_vma")
+        return _exp_shard_map(f, *args, **kw)
+
+    jax.shard_map = _shard_map
+
 from .. import keys as keymod
 from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
 from ..conflict.device import (

@@ -47,6 +47,7 @@ from ..runtime.combinators import wait_all, wait_any
 from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority, TimedOut
 from ..runtime.knobs import CoreKnobs
 from ..runtime.buggify import buggify, maybe_delay
+from ..runtime.metrics import LatencyTracker
 from ..runtime.trace import CounterCollection, g_trace_batch
 from ..runtime.coverage import testcov
 
@@ -84,6 +85,7 @@ class KeyPartitionMap:
 class _PendingCommit:
     request: CommitTransactionRequest
     reply_cb: object  # ReceivedRequest
+    arrive: float = 0.0  # loop.now() at receipt — feeds the latency bands
 
 
 class CommitProxy:
@@ -159,6 +161,19 @@ class CommitProxy:
         self.c_conflicted = self.counters.counter("txns_conflicted")
         self.c_batches = self.counters.counter("commit_batches")
         self.c_throttled = self.counters.counter("mvcc_window_throttles")
+        # SLO latency surface (flow/Stats.h LatencyBands + per-stage
+        # histograms): "commit" is end-to-end receipt→reply (the band set
+        # operators alert on), "grv" the read-version service, the stage
+        # trackers the commitBatch phases — where the time goes when the
+        # commit band degrades.  All in SIMULATED seconds.
+        self.latency = {
+            "commit": LatencyTracker(),
+            "grv": LatencyTracker(),
+            "batch_wait": LatencyTracker(),
+            "version_assign": LatencyTracker(),
+            "resolution": LatencyTracker(),
+            "tlog_push": LatencyTracker(),
+        }
         self._pending: list[_PendingCommit] = []
         self._batch_tasks: list = []  # in-flight commit batches (stop() kills)
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
@@ -220,7 +235,9 @@ class CommitProxy:
     async def _accept_commits(self) -> None:
         while True:
             req = await self.commit_stream.next()
-            self._pending.append(_PendingCommit(req.payload, req))
+            self._pending.append(
+                _PendingCommit(req.payload, req, arrive=self.loop.now())
+            )
 
     async def _batcher(self) -> None:
         """Fire a commit batch every interval (dynamic batching: the
@@ -314,7 +331,12 @@ class CommitProxy:
                     testcov("proxy.database_locked")
                     pc.reply_cb.reply(CommitReply(CommitResult.DATABASE_LOCKED))
             batch = allowed
-        deadline = self.loop.now() + self.knobs.COMMIT_PATH_GIVEUP
+        t_start = self.loop.now()
+        if batch:
+            bw = self.latency["batch_wait"]
+            for pc in batch:
+                bw.observe(t_start - pc.arrive)
+        deadline = t_start + self.knobs.COMMIT_PATH_GIVEUP
         self._req_num += 1
         # sampled debug IDs only (usually none): the station loops below
         # must cost nothing on the un-sampled hot path
@@ -330,11 +352,14 @@ class CommitProxy:
             deadline,
         )
         prev_v, version = gv.prev_version, gv.version
+        if batch:
+            self.latency["version_assign"].observe(self.loop.now() - t_start)
         for d in dbg:
             g_trace_batch.add("CommitProxyServer.commitBatch.GotCommitVersion", d)
 
         # phase 2: per-resolver range split (ResolutionRequestBuilder :242)
         # using the partition map effective at THIS batch's version
+        t_res = self.loop.now()
         rmap = self.rmap_at(version)
         n_res = len(self.resolvers)
         per_res: list[list[TxInfo]] = [[] for _ in range(n_res)]
@@ -371,6 +396,8 @@ class CommitProxy:
             Verdict(min(int(rep.committed[i]) for rep in replies))
             for i in range(len(batch))
         ]
+        if batch:
+            self.latency["resolution"].observe(self.loop.now() - t_res)
         for d in dbg:
             g_trace_batch.add("CommitProxyServer.commitBatch.AfterResolution", d)
 
@@ -454,6 +481,7 @@ class CommitProxy:
         for tag, muts in by_tag.items():
             for idx in self.tag_to_tlogs[tag]:
                 per_tlog[idx][tag] = muts
+        t_push = self.loop.now()
         await wait_all(
             [
                 self.loop.spawn(
@@ -482,9 +510,14 @@ class CommitProxy:
         # TEST at :943).
         if self.committed_version.get() < version:
             self.committed_version.set(version)
+        if batch:
+            self.latency["tlog_push"].observe(self.loop.now() - t_push)
         for d in dbg:
             g_trace_batch.add("CommitProxyServer.commitBatch.AfterLogPush", d)
+        t_reply = self.loop.now()
+        commit_lat = self.latency["commit"]
         for pc, v in zip(batch, verdicts):
+            commit_lat.observe(t_reply - pc.arrive)
             if v == Verdict.COMMITTED:
                 self.c_committed.add(1)
                 pc.reply_cb.reply(CommitReply(CommitResult.COMMITTED, version))
@@ -591,7 +624,7 @@ class CommitProxy:
         batch.  Causally safe because committed versions only advance after
         all-TLog durability, and the liveness confirmation means no newer
         generation can have committed anything this proxy hasn't seen."""
-        pend_default: list = []  # (expiry, req) — parked by the throttle
+        pend_default: list = []  # (expiry, arrive, req) — parked by throttle
         pend_batch: list = []
         while True:
             # drain arrivals; while throttled requests wait, poll instead of
@@ -605,15 +638,15 @@ class CommitProxy:
             while len(self.grv_stream.requests):
                 pend.append(await self.grv_stream.next())
             now = self.loop.now()
-            reqs = []
+            reqs = []  # (arrive, req) — arrival feeds the GRV latency bands
             for r in pend:
                 pri = getattr(r.payload, "priority", PRIORITY_DEFAULT)
                 if pri >= PRIORITY_IMMEDIATE:
-                    reqs.append(r)  # IMMEDIATE: bypasses admission control
+                    reqs.append((now, r))  # IMMEDIATE: bypasses admission
                 elif pri == PRIORITY_BATCH:
-                    pend_batch.append((now + 6.0, r))
+                    pend_batch.append((now + 6.0, now, r))
                 else:
-                    pend_default.append((now + 6.0, r))
+                    pend_default.append((now + 6.0, now, r))
             # a parked request whose client has long since timed out and
             # re-routed is garbage — drop it instead of growing forever
             pend_default = [e for e in pend_default if e[0] > now]
@@ -624,7 +657,7 @@ class CommitProxy:
                 n = min(len(pend_default), int(self._grv_tokens))
                 if n:
                     self._grv_tokens -= n
-                    reqs.extend(r for _e, r in pend_default[:n])
+                    reqs.extend((a, r) for _e, a, r in pend_default[:n])
                     del pend_default[:n]
                 # batch admissions count against BOTH budgets: the batch
                 # bucket is the class's (harsher) cap, the default bucket is
@@ -637,13 +670,13 @@ class CommitProxy:
                 if nb:
                     self._grv_batch_tokens -= nb
                     self._grv_tokens -= nb
-                    reqs.extend(r for _e, r in pend_batch[:nb])
+                    reqs.extend((a, r) for _e, a, r in pend_batch[:nb])
                     del pend_batch[:nb]
                 if (pend_default or pend_batch) and not reqs:
                     testcov("proxy.grv_throttled")
             else:
-                reqs.extend(r for _e, r in pend_default)
-                reqs.extend(r for _e, r in pend_batch)
+                reqs.extend((a, r) for _e, a, r in pend_default)
+                reqs.extend((a, r) for _e, a, r in pend_batch)
                 pend_default, pend_batch = [], []
             if not reqs:
                 continue
@@ -670,11 +703,14 @@ class CommitProxy:
                 await self.loop.delay(0.05, TaskPriority.GET_LIVE_VERSION)
             await maybe_delay(self.loop, "proxy.delay_grv")
             version = self.committed_version.get()
-            for r in reqs:
+            t_reply = self.loop.now()
+            grv_lat = self.latency["grv"]
+            for arrive, r in reqs:
                 g_trace_batch.add(
                     "GrvProxyServer.transactionStarter.AskLiveCommittedVersion",
                     getattr(r.payload, "debug_id", None),
                 )
+                grv_lat.observe(t_reply - arrive)
                 r.reply(GetReadVersionReply(version))
 
     def stop(self) -> None:

@@ -32,6 +32,7 @@ from ..rpc.stream import RequestStream
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.knobs import CoreKnobs
+from ..runtime.metrics import LatencyTracker
 from ..runtime.trace import CounterCollection
 
 
@@ -56,6 +57,10 @@ class Resolver:
         self.c_batches = self.counters.counter("batches")
         self.c_txns = self.counters.counter("txns")
         self.c_conflicts = self.counters.counter("conflicts")
+        # _resolve_one receipt→reply in simulated seconds: includes the
+        # version-chain wait, so a stalled chain shows up HERE while the
+        # backend's own wall time lives in cs.kernel_stats()
+        self.latency = LatencyTracker()
         # recent batch outcomes so a proxy retry of an already-resolved
         # version re-receives its real verdicts (the reference caches recent
         # replies; abort-all would turn every retried batch into aborts)
@@ -85,6 +90,7 @@ class Resolver:
 
     async def _resolve_one(self, req) -> None:
         r: ResolveTransactionBatchRequest = req.payload
+        t0 = self.loop.now()
         await maybe_delay(self.loop, "resolver.delay_resolve")
         await self.version.when_at_least(r.prev_version)
         if self.version.get() >= r.version:
@@ -127,6 +133,7 @@ class Resolver:
         committed = [int(v) for v in verdicts]
         self._reply_cache[r.version] = committed
         self.version.set(r.version)
+        self.latency.observe(self.loop.now() - t0)
         req.reply(ResolveTransactionBatchReply(committed=committed))
 
     def stop(self) -> None:

@@ -38,6 +38,7 @@ from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
+from ..runtime.metrics import LatencyTracker
 from ..runtime.trace import g_trace_batch
 from ..runtime.knobs import CoreKnobs
 
@@ -320,6 +321,10 @@ class StorageServer:
         from ..utils.rangemap import KeyRangeMap
 
         self._range_floor = KeyRangeMap(default=0)
+        # read-path latency bands (receipt→reply, simulated seconds): point
+        # gets and range reads share one tracker — the storage half of the
+        # reference's readLatencyBands
+        self.read_latency = LatencyTracker()
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
         self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
@@ -639,6 +644,7 @@ class StorageServer:
 
     async def _getvalue_one(self, req) -> None:
         r: GetValueRequest = req.payload
+        t0 = self.loop.now()
         g_trace_batch.add("StorageServer.getValue.Received", r.debug_id)
         await maybe_delay(self.loop, "storage.delay_read")
         try:
@@ -653,6 +659,7 @@ class StorageServer:
             req.reply_error(e)
             return
         req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
+        self.read_latency.observe(self.loop.now() - t0)
         g_trace_batch.add("StorageServer.getValue.Replied", r.debug_id)
 
     # -- watches (storageserver watch futures) -------------------------------
@@ -700,6 +707,7 @@ class StorageServer:
 
     async def _getkv_one(self, req) -> None:
         r: GetKeyValuesRequest = req.payload
+        t0 = self.loop.now()
         try:
             await self._wait_version(r.version)
             if any(
@@ -724,6 +732,7 @@ class StorageServer:
                 break
         more = len(out) > r.limit
         req.reply(GetKeyValuesReply(out[: r.limit], more))
+        self.read_latency.observe(self.loop.now() - t0)
 
     def set_tlog_source(
         self,

@@ -55,6 +55,132 @@ class Smoother:
         return (self._total - self._estimate) / self.e_time
 
 
+# SLO-facing latency thresholds (seconds): the flow/Stats.h LatencyBands
+# defaults the reference wires into GRV/commit/read stats — operators alert
+# on band counts, Ratekeeper reasons about the tail bands.
+DEFAULT_LATENCY_BANDS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+class LatencyBands:
+    """Counts per latency threshold bucket (flow/Stats.h:155 LatencyBands).
+
+    Buckets are DISJOINT — measurement m lands in the first band with
+    m < threshold, or the overflow band — so the bucket counts always sum
+    to the total number of operations (the invariant status consumers
+    check).  The reference keeps cumulative <=threshold counters; disjoint
+    buckets carry the same information and sum cleanly across roles."""
+
+    def __init__(self, thresholds: tuple[float, ...] = DEFAULT_LATENCY_BANDS) -> None:
+        self.thresholds = tuple(thresholds)
+        self.counts = [0] * (len(self.thresholds) + 1)
+
+    def add(self, latency: float) -> None:
+        for i, t in enumerate(self.thresholds):
+            if latency < t:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def snapshot(self) -> dict:
+        bands = {f"<{t:g}": c for t, c in zip(self.thresholds, self.counts)}
+        bands[f">={self.thresholds[-1]:g}"] = self.counts[-1]
+        return {"count": self.count, "bands": bands}
+
+
+class LatencyTracker:
+    """One pipeline stage's latency model: SLO bands + a uniform reservoir
+    for percentiles + sum/max — the LatencyBands-plus-ContinuousSample pair
+    every instrumented station in the commit/GRV/read paths owns."""
+
+    def __init__(
+        self,
+        thresholds: tuple[float, ...] = DEFAULT_LATENCY_BANDS,
+        sample_size: int = 500,
+    ) -> None:
+        self.bands = LatencyBands(thresholds)
+        self.sample = ContinuousSample(sample_size)
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, latency: float) -> None:
+        self.bands.add(latency)
+        self.sample.add(latency)
+        self.sum += latency
+        if latency > self.max:
+            self.max = latency
+
+    @property
+    def count(self) -> int:
+        return self.bands.count
+
+    def snapshot(self) -> dict:
+        n = self.count
+        return {
+            "count": n,
+            "mean": self.sum / n if n else 0.0,
+            "max": self.max,
+            "p50": self.sample.percentile(0.5),
+            "p95": self.sample.percentile(0.95),
+            "p99": self.sample.percentile(0.99),
+            "bands": self.bands.snapshot()["bands"],
+        }
+
+    @classmethod
+    def merged(cls, trackers: "list[LatencyTracker]") -> dict:
+        """One snapshot over several trackers (e.g. the same stage across
+        all proxies): counts and bands sum, percentiles come from the
+        pooled reservoirs — the merge the status roll-up needs, done on
+        the tracker objects because percentiles cannot be merged from
+        finished snapshots.
+
+        Reservoirs are fixed-size, so each sample is WEIGHTED by how many
+        observations it represents (t.count / len(samples)): a proxy that
+        served 100k commits must not be averaged 50/50 against one that
+        served 500, or the merged p50 reads like the idle proxy."""
+        out = cls()
+        bands: dict[str, int] = {}
+        weighted: list[tuple[float, float]] = []
+        n = 0
+        for t in trackers:
+            n += t.count
+            out.sum += t.sum
+            out.max = max(out.max, t.max)
+            for k, v in t.bands.snapshot()["bands"].items():
+                bands[k] = bands.get(k, 0) + v
+            if t.sample._samples:
+                w = t.count / len(t.sample._samples)
+                weighted.extend((v, w) for v in t.sample._samples)
+        weighted.sort()
+        total_w = sum(w for _v, w in weighted)
+
+        def pct(p: float) -> float:
+            if not weighted:
+                return 0.0
+            target = p * total_w
+            acc = 0.0
+            for v, w in weighted:
+                acc += w
+                if acc >= target:
+                    return v
+            return weighted[-1][0]
+
+        return {
+            "count": n,
+            "mean": out.sum / n if n else 0.0,
+            "max": out.max,
+            "p50": pct(0.5),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+            "bands": bands,
+        }
+
+
 class ContinuousSample:
     """Fixed-size uniform reservoir over a stream, with percentile reads
     (flow/ContinuousSample.h): every element ever added has equal

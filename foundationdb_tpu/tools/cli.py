@@ -27,6 +27,8 @@ commands:
   getrange <begin> <end> [n]  scan up to n keys (default 25)
   watch <key>                 block until the key changes
   status [json]               cluster status summary (or full json)
+  sample <rate>               sample a fraction of txns into the timeline
+  timeline [id]               sampled-transaction station report(s)
   configure k=v ...           change role counts (n_tlogs/n_proxies/n_resolvers)
   exclude <target> ...        drain + ban machines/processes (ManagementAPI)
   include [target ...]        re-admit targets (none = all)
@@ -117,6 +119,17 @@ class Cli:
                 f"{doc['proxy']['txns_conflicted']} conflicted, "
                 f"version {doc['proxy']['committed_version']}",
             ]
+            lb = doc.get("latency_bands")
+            if lb and lb["commit"]["count"]:
+                lines.append(
+                    f"commit latency: p50 {lb['commit']['p50'] * 1e3:.2f} ms, "
+                    f"p99 {lb['commit']['p99'] * 1e3:.2f} ms "
+                    f"({lb['commit']['count']} txns); "
+                    f"grv p99 {lb['grv']['p99'] * 1e3:.2f} ms"
+                )
+            for m in doc["cluster"].get("messages", []):
+                lines.append(f"message [{m['severity']}] {m['name']}: "
+                             f"{m['description']}")
             conf = doc["cluster"].get("configuration")
             if conf is not None:
                 lines.append(
@@ -139,6 +152,22 @@ class Cli:
                     f"storage {s['tag']}: {s['keys']} keys, v{s['version']}"
                 )
             return "\n".join(lines)
+        if cmd == "sample":
+            self.db.debug_sample_rate = float(args[0])
+            return f"debug sample rate = {self.db.debug_sample_rate}"
+        if cmd == "timeline":
+            from .timeline import format_report, timeline_dump, timeline_report
+
+            if args:
+                return format_report(timeline_report(args[0]))
+            reports = timeline_dump(limit=25)["transactions"]
+            if not reports:
+                return "<no sampled transactions; use `sample 1.0` first>"
+            return "\n".join(
+                f"{r['id']}  ({r['station_count']} stations, "
+                f"{r['total_s'] * 1e3:.3f} ms)"
+                for r in reports
+            )
         if cmd == "configure":
             # configure n_tlogs=3 n_proxies=2 ... (ManagementAPI changeConfig)
             from ..client.management import configure

@@ -30,6 +30,16 @@ def main(argv=None) -> None:
     ap.add_argument("--workers", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--timeline-file", default=None,
+                    help="scrape endpoint for sampled-transaction pipeline "
+                         "timelines: rewrite this file with the "
+                         "tools/timeline.py JSON dump every "
+                         "--timeline-interval seconds (clients can also "
+                         "read the \\xff\\xff/timeline/json special key)")
+    ap.add_argument("--timeline-interval", type=float, default=5.0)
+    ap.add_argument("--sample-rate", type=float, default=0.0,
+                    help="fraction of gateway transactions given a debug ID "
+                         "(feeds the timeline scrape)")
     ap.add_argument("--cluster-file", default=None,
                     help="fdb.cluster naming REMOTE coordinator processes "
                          "(tools/coordserver.py); the recovery state lives "
@@ -87,7 +97,30 @@ def main(argv=None) -> None:
         trace_sink=sink,
         **extra,
     )
-    gw = ClientGateway(cluster.loop, cluster.database(), port=args.port)
+    db = cluster.database()
+    if args.sample_rate > 0:
+        db.debug_sample_rate = args.sample_rate
+    gw = ClientGateway(cluster.loop, db, port=args.port)
+    if args.timeline_file:
+        # the ops scrape surface: atomically rewrite the dump on a cadence
+        # so a file-watching collector always reads a complete document
+        import json as _json
+        import os as _os
+
+        from .timeline import timeline_dump
+
+        async def dump_timelines() -> None:
+            while True:
+                await cluster.loop.delay(args.timeline_interval)
+                tmp = args.timeline_file + ".tmp"
+                try:
+                    with open(tmp, "w") as f:
+                        _json.dump(timeline_dump(), f, default=str)
+                    _os.replace(tmp, args.timeline_file)
+                except OSError:
+                    pass  # a full disk must not kill the server
+
+        cluster.loop.spawn(dump_timelines())
     driver = GatewayDriver(
         cluster.loop, gw,
         extra_pump=rnet.pump if rnet is not None else None,

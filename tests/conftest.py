@@ -12,10 +12,24 @@ latched when sitecustomize imported jax) and drop the plugin's backend
 factory before the first op initializes backends.
 """
 
-import jax
+import os
+
+# older jax (< jax_num_cpu_devices) reads the device count from XLA_FLAGS at
+# CPU-client creation; set it before any op initializes backends so both
+# paths below produce the same 8-device mesh
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
+    pass
 
 from jax._src import xla_bridge as _xb  # noqa: E402
 

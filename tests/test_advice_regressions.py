@@ -322,3 +322,81 @@ def test_unknown_result_fence_commits_through_locked_database():
 
     assert c.run_until(c.loop.spawn(main()), 300)
     c.stop()
+
+
+class TestRound5Advice:
+    """Round-5 advisor findings (observability PR satellites)."""
+
+    def test_memory_engine_refuses_total_tag_loss(self):
+        """ADVICE round 5: required_tags is passed to LogSystem.lock even
+        with no filesystem — a memory-engine cluster losing EVERY replica
+        slot of a storage tag must refuse to recover (the data is gone and
+        there is no disk fallback), not silently recruit a fresh empty
+        generation."""
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        c = RecoverableCluster(
+            seed=651, n_storage_shards=1, storage_replication=2,
+            durable=False,  # memory engine: no TLog files to fall back to
+        )
+        db = c.database()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set(b"k", b"v")
+            await tr.commit()
+            # kill EVERY TLog: all replica slots of every tag are lost
+            for t in c.controller.generation.tlogs:
+                t.process.kill()
+            await c.loop.delay(5.0)
+
+        c.run_until(c.loop.spawn(main()), 300)
+        errs = c.trace.find("MasterRecoveryError")
+        assert any("lost" in e["Error"] for e in errs), errs
+        # and no fresh generation ever reached ACCEPTING_COMMITS
+        assert c.controller.recovery_state != "fully_recovered" or not errs
+        c.stop()
+
+    def test_lock_recovered_before_first_conf_poll(self):
+        """ADVICE round 5: a restarted cluster re-learns the database lock
+        from the recovered system keyspace DURING recovery — even when the
+        lock commit never reached durable storage (it survives only in the
+        TLog files) — so not a single non-lock-aware commit can slip in
+        before the first conf-poll tick."""
+        from foundationdb_tpu.client import management as mgmt
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+        c = RecoverableCluster(seed=652, n_storage_shards=1,
+                               storage_replication=2)
+        db = c.database()
+
+        async def do_lock():
+            await mgmt.lock_database(db, b"lock-uid-9")
+            # deliberately SHORTER than the storage durability lag: the
+            # lock row lives only in the TLogs at power-off
+            await c.loop.delay(0.5)
+
+        c.run_until(c.loop.spawn(do_lock()), 300)
+        fs = c.power_off()
+        c2 = RecoverableCluster(seed=653, fs=fs, restart=True,
+                                n_storage_shards=1, storage_replication=2)
+        # immediately after bootstrap — no conf poll has run yet
+        assert c2.controller._locked == b"lock-uid-9"
+        for p in c2.controller.generation.proxies:
+            assert p.locked == b"lock-uid-9"
+        # and the lock is enforced: a plain commit is refused
+        from foundationdb_tpu.roles.errors import DatabaseLocked
+
+        db2 = c2.database()
+
+        async def try_commit():
+            tr = db2.create_transaction()
+            tr.set(b"x", b"y")
+            try:
+                await tr.commit()
+            except DatabaseLocked:
+                return "locked"
+            return "committed"
+
+        assert c2.run_until(c2.loop.spawn(try_commit()), 300) == "locked"
+        c2.stop()

@@ -1,5 +1,6 @@
 """Observability: per-transaction pipeline timelines (g_traceBatch analog),
-the flow-profiler analog, and the schema-checked status document
+the flow-profiler analog, latency bands + kernel profiling counters, and
+the schema-checked status document
 (flow/Trace.h:253; fdbclient/Schemas.cpp; the reference profiler)."""
 
 from foundationdb_tpu.control.recoverable import RecoverableCluster
@@ -113,4 +114,184 @@ def test_profiler_accumulates_busy_time():
     c.run_until(c.loop.spawn(main()), 300)
     assert sum(c.loop.busy_s_by_priority.values()) > 0
     assert len(c.loop.busy_s_by_priority) > 1  # multiple priorities ran
+    c.stop()
+
+
+# -- latency bands + kernel counters + timeline tool (observability PR) ------
+
+
+def test_latency_bands_unit():
+    """Metrics smoke: disjoint buckets sum to the count, percentiles order,
+    merged snapshots pool correctly — the fast tier-1 regression for the
+    LatencyBands/LatencyTracker primitives."""
+    from foundationdb_tpu.runtime.metrics import LatencyBands, LatencyTracker
+
+    lb = LatencyBands()
+    for v in (0.0001, 0.002, 0.03, 0.3, 7.0):
+        lb.add(v)
+    snap = lb.snapshot()
+    assert snap["count"] == 5
+    assert sum(snap["bands"].values()) == 5
+    assert snap["bands"]["<0.001"] == 1 and snap["bands"][">=5"] == 1
+
+    t = LatencyTracker()
+    for i in range(100):
+        t.observe(i * 0.001)
+    s = t.snapshot()
+    assert s["count"] == 100 and sum(s["bands"].values()) == 100
+    assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"] == 0.099
+    assert abs(s["mean"] - 0.0495) < 1e-9
+
+    t2 = LatencyTracker()
+    t2.observe(1.0)
+    m = LatencyTracker.merged([t, t2])
+    assert m["count"] == 101 and sum(m["bands"].values()) == 101
+    assert m["max"] == 1.0 and m["p50"] < 1.0
+
+
+def test_kernel_stats_uniform_across_backends():
+    """Every conflict backend answers kernel_stats() with the same shape,
+    so parity checks can also compare cost (tentpole seam 2)."""
+    from foundationdb_tpu.conflict.api import TxInfo
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+
+    txns = [
+        TxInfo(5, [(b"a", b"b")], [(b"a", b"b")]),
+        TxInfo(5, [(b"a", b"b")], []),
+    ]
+    oracle, device = OracleConflictSet(), DeviceConflictSet(capacity=1 << 9)
+    vo = oracle.resolve_batch(10, txns)
+    vd = device.resolve_batch(10, txns)
+    assert vo == vd  # parity on the tiny batch
+    so, sd = oracle.kernel_stats(), device.kernel_stats()
+    assert set(so) == set(sd)  # ONE shape across backends
+    for s in (so, sd):
+        assert s["batches"] == 1 and s["txns"] == 2 and s["aborted"] == 1
+        assert s["abort_rate"] == 0.5
+        assert s["node_count"] > 0
+        assert s["resolve_ms_p50"] >= 0
+    assert so["occupancy"] == 1.0        # the oracle never pads
+    assert 0 < sd["occupancy"] < 1.0     # bucketing always pads a 3-row batch
+    assert sd["recompiles"] == 1
+    # GC is visible uniformly too
+    oracle.remove_before(8)
+    device.remove_before(8)
+    assert oracle.kernel_stats()["gc_calls"] == 1
+    assert device.kernel_stats()["gc_calls"] == 1
+
+
+def test_timeline_tool_reconstructs_stations():
+    """A sampled transaction's debug ID joins >= 4 pipeline stations in
+    monotonically non-decreasing time order, and the scrape surfaces
+    (module API + special key) agree."""
+    import json
+
+    from foundationdb_tpu.tools.timeline import (
+        format_report,
+        sampled_ids,
+        timeline_report,
+    )
+
+    c = RecoverableCluster(seed=611, n_storage_shards=1, storage_replication=2)
+    g_trace_batch.clear()
+    db = c.database()
+    db.debug_sample_rate = 1.0
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"tl", b"1")
+        await tr.commit()
+        tr2 = db.create_transaction()
+        blob = await tr2.get(b"\xff\xff/timeline/json")
+        return tr.debug_id, blob
+
+    cid, blob = c.run_until(c.loop.spawn(main()), 300)
+    rep = timeline_report(cid)
+    assert rep["station_count"] >= 4
+    times = [s["time"] for s in rep["stations"]]
+    assert times == sorted(times)  # monotonically non-decreasing
+    assert all(s["delta"] >= 0 for s in rep["stations"])
+    assert rep["total_s"] > 0
+    # the commit pipeline's stations are all on the journey
+    locs = [s["location"] for s in rep["stations"]]
+    for want in (
+        "CommitProxyServer.commitBatch.Before",
+        "CommitProxyServer.commitBatch.GotCommitVersion",
+        "CommitProxyServer.commitBatch.AfterResolution",
+        "CommitProxyServer.commitBatch.AfterLogPush",
+    ):
+        assert want in locs
+    assert cid in sampled_ids()
+    assert cid in format_report(rep)
+    # the scrape endpoint serves the same reconstruction
+    doc = json.loads(blob)
+    assert any(t["id"] == cid for t in doc["transactions"])
+    c.stop()
+
+
+def test_status_latency_bands_and_kernel():
+    """Acceptance: after a workload, cluster_status carries latency_bands
+    (commit + GRV, bucket counts summing to total operations) and a
+    populated kernel section."""
+    c = RecoverableCluster(seed=612, n_storage_shards=1, storage_replication=2)
+    db = c.database()
+
+    async def main():
+        for i in range(12):
+            tr = db.create_transaction()
+            tr.set(b"lb%02d" % i, b"v")
+            await tr.commit()
+        tr = db.create_transaction()
+        return await tr.get(b"lb00")
+
+    assert c.run_until(c.loop.spawn(main()), 300) == b"v"
+    doc = cluster_status(c)
+    validate_status(doc)
+    lb = doc["latency_bands"]
+    assert lb["commit"]["count"] >= 12
+    assert sum(lb["commit"]["bands"].values()) == lb["commit"]["count"]
+    assert lb["grv"]["count"] >= 13
+    assert sum(lb["grv"]["bands"].values()) == lb["grv"]["count"]
+    assert lb["commit"]["p99"] >= lb["commit"]["p50"] > 0
+    for stage in ("batch_wait", "version_assign", "resolution", "tlog_push"):
+        st = lb["stages"][stage]
+        assert st["count"] >= 12
+        assert sum(st["bands"].values()) == st["count"]
+    assert lb["resolver"]["count"] >= 1
+    assert lb["storage_read"]["count"] >= 1
+    k = doc["kernel"]
+    assert k["txns"] >= 12 and k["batches"] >= 1
+    assert 0 < k["occupancy"] <= 1.0
+    assert 0.0 <= k["abort_rate"] <= 1.0
+    assert k["node_count"] > 0
+    assert k["resolve_ms_p99"] >= k["resolve_ms_p50"] >= 0
+    assert len(k["per_resolver"]) == 1
+    # the roll-up carries the SAME shape as a per-backend snapshot
+    assert set(k) - {"per_resolver"} == set(k["per_resolver"][0])
+    assert isinstance(doc["cluster"]["messages"], list)
+    c.stop()
+
+
+def test_status_messages_surface_warnings_and_ratekeeper():
+    """SEV_WARN+ track_latest events and a limited ratekeeper become
+    operator messages."""
+    from foundationdb_tpu.runtime.trace import SEV_WARN
+
+    c = RecoverableCluster(seed=613, n_storage_shards=1, storage_replication=2)
+    c.trace.trace(
+        "TestDegradation", severity=SEV_WARN, track_latest="test-degraded",
+        Detail="synthetic",
+    )
+    c.ratekeeper.limit_reason = "storage_lag"
+    c.ratekeeper.limiting_server = "ss-0-r0"
+    doc = cluster_status(c)
+    validate_status(doc)
+    names = [m["name"] for m in doc["cluster"]["messages"]]
+    assert "TestDegradation" in names
+    assert "performance_limited" in names
+    perf = next(m for m in doc["cluster"]["messages"]
+                if m["name"] == "performance_limited")
+    assert "storage_lag" in perf["description"]
+    assert "ss-0-r0" in perf["description"]
     c.stop()
