@@ -182,50 +182,100 @@ def _enable_compile_cache() -> None:
         print(f"[bench] compile cache unavailable: {e!r}", file=sys.stderr)
 
 
-def _init_backend(timeout_s: float, retries: int = 2) -> dict:
+_BENCH_STATE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_state"
+)
+_PROBE_CACHE = os.path.join(_BENCH_STATE_DIR, "probe.json")
+
+
+def _probe_cache_read() -> dict | None:
+    try:
+        with open(_PROBE_CACHE) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — missing/corrupt cache == no cache
+        return None
+
+
+def _probe_cache_write(ok: bool, detail: str) -> None:
+    try:
+        os.makedirs(_BENCH_STATE_DIR, exist_ok=True)
+        with open(_PROBE_CACHE, "w") as f:
+            json.dump(
+                {"ok": ok, "detail": detail[:300], "ts": time.time()}, f
+            )
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(f"[bench] probe cache write failed: {e!r}", file=sys.stderr)
+
+
+def _init_backend(timeout_s: float | None = None) -> dict:
     """Initialize the JAX backend defensively.
 
     The axon TPU tunnel in this environment can hang for minutes or die
     with Unavailable; a bench that crashes before printing ANY number is
-    worthless (round-1 lesson: BENCH_r01 was rc=1 with no output) and a
-    bench that gives up after ONE hung attempt records nothing (round-4
-    lesson: BENCH_r04).  A hung in-process PJRT init cannot be retried —
-    the C++ layer holds global state — so each attempt probes the tunnel
-    in a SUBPROCESS that a timeout can actually kill, with backoff between
-    attempts; only after a probe succeeds does the in-process init run
-    (itself on a daemon thread with a timeout, in case the tunnel dies in
-    the gap).  Failure is reported as data instead of dying."""
+    worthless (round-1 lesson: BENCH_r01 was rc=1 with no output), and a
+    bench that burns 2x180 s of probe timeout on EVERY run while the tunnel
+    is down wastes most of the round budget re-measuring a known-dead link
+    (round-4/5 lesson: BENCH_r04/r05).  So:
+
+      * the last probe outcome is cached in .bench_state/probe.json;
+      * the first probe is SHORT (~20 s — a live tunnel answers the 64-int
+        round trip well inside that);
+      * exactly one retry follows, and only when the cache does NOT already
+        say the tunnel was down last run (a cached failure fast-fails the
+        run at one short probe, keeping total probe time ~20 s; no cache or
+        a cached success earns the benefit of the doubt).
+
+    Worst-case probing is ~20 + ~35 s < 60 s, after which main() emits the
+    native-CPU metric line (already measured before probing started).
+    A hung in-process PJRT init cannot be retried — the C++ layer holds
+    global state — so probes run in a SUBPROCESS that a timeout can kill;
+    only after one succeeds does the in-process init run (on a daemon
+    thread with a timeout, in case the tunnel dies in the gap)."""
     import subprocess
     import threading
     import traceback
 
-    retries = int(os.environ.get("BENCH_INIT_ATTEMPTS", str(retries)))
+    fast_s = float(os.environ.get("BENCH_PROBE_FAST_S", "20"))
+    retry_s = float(
+        os.environ.get("BENCH_INIT_TIMEOUT", str(timeout_s or 35))
+    )
+    cache = _probe_cache_read()
+    budgets = [fast_s]
+    if cache is None or cache.get("ok", False):
+        budgets.append(retry_s)
+    else:
+        print(
+            f"[bench] probe cache: tunnel was down last run "
+            f"({cache.get('detail', '?')}); one short probe only",
+            file=sys.stderr,
+        )
+
     result: dict = {}
-    for attempt in range(retries):
+    for attempt, budget in enumerate(budgets):
         t0 = time.perf_counter()
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout_s,
+                capture_output=True, text=True, timeout=budget,
             )
             ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
             detail = (proc.stdout + proc.stderr).strip().splitlines()
             detail = detail[-1][:300] if detail else f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
-            ok, detail = False, f"probe hung > {timeout_s}s (killed)"
+            ok, detail = False, f"probe hung > {budget}s (killed)"
         dt = time.perf_counter() - t0
         if ok:
             print(f"[bench] probe OK in {dt:.1f}s: {detail}", file=sys.stderr)
+            _probe_cache_write(True, detail)
             break
         result["error"] = detail
         print(
-            f"[bench] probe attempt {attempt + 1}/{retries} failed after "
-            f"{dt:.1f}s: {detail}",
+            f"[bench] probe attempt {attempt + 1}/{len(budgets)} failed "
+            f"after {dt:.1f}s: {detail}",
             file=sys.stderr,
         )
-        if attempt + 1 < retries:
-            time.sleep(20.0 * (attempt + 1))
     else:
+        _probe_cache_write(False, result.get("error", "?"))
         return result
 
     # tunnel answers: init in-process (still guarded — it can die in the gap)
@@ -244,9 +294,9 @@ def _init_backend(timeout_s: float, retries: int = 2) -> dict:
     t = threading.Thread(target=target, daemon=True)
     t.start()
     # the probe JUST verified the tunnel; a subsequent in-process hang
-    # means it died in the gap, and waiting the full probe budget again
-    # only delays the native-number fallback
-    join_s = min(timeout_s, 120.0)
+    # means it died in the gap, and waiting long again only delays the
+    # native-number fallback
+    join_s = float(os.environ.get("BENCH_INIT_JOIN_S", "120"))
     t.join(join_s)
     if t.is_alive():
         result["error"] = f"in-process init hung > {join_s}s after probe OK"
@@ -275,7 +325,54 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
     print(json.dumps(doc))
 
 
+def _cpu_phase_main() -> None:
+    """`bench.py --cpu-phase`: a small JAX-CPU kernel pass that prints the
+    per-phase breakdown as one JSON line — run in a SUBPROCESS by the
+    no-device path so the kernel's phase costs land in BENCH json even when
+    the tunnel is down (small shapes: this is a phase-shape sample, not a
+    throughput number).  The drive loop is shared with
+    `profile_kernel.py --phase` so the two reports cannot desynchronize."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from profile_kernel import drive_phase_stream
+
+    _dev, snap = drive_phase_stream(
+        n_batches=10, n_txns=256, cap=1 << 14, run_slots=4, seed=SEED,
+    )
+    print(json.dumps({
+        "phase": {k: round(v, 2) for k, v in snap["phase"].items()},
+        "phase_backend": "cpu",
+        "runs_appended": snap["runs_appended"],
+        "full_merges": snap["full_merges"],
+        "compactions": snap["compactions"],
+        "batches": snap["batches"],
+    }))
+
+
+def _cpu_phase_probe() -> dict | None:
+    """Run _cpu_phase_main in a subprocess (budgeted, opt-out with
+    BENCH_CPU_PHASE=0) and return its parsed JSON, or None."""
+    import subprocess
+
+    if os.environ.get("BENCH_CPU_PHASE", "1") == "0":
+        return None
+    budget = float(os.environ.get("BENCH_CPU_PHASE_TIMEOUT", "180"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-phase"],
+            capture_output=True, text=True, timeout=budget,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = proc.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — the phase sample is optional data
+        print(f"[bench] cpu phase pass failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
+    if "--cpu-phase" in sys.argv:
+        _cpu_phase_main()
+        return
     from foundationdb_tpu.conflict.native import NativeConflictSet
 
     rng = np.random.default_rng(SEED)
@@ -310,20 +407,24 @@ def main() -> None:
     native_rate = total_checks / native_s
 
     # ---------------- backend init (resilient) ----------------
-    # worst case time-to-JSON must stay inside any plausible driver budget:
-    # 2 probe attempts x 180s + 20s backoff + a 120s in-process init join
-    # ~= 8.4 min, then the native line hits stdout if no device ever
-    # materializes (r4's 4+-minute run was recorded, so the budget fits)
-    init = _init_backend(timeout_s=float(os.environ.get("BENCH_INIT_TIMEOUT", "180")))
+    # worst case time-to-JSON: one short probe (+ one ~35s retry when the
+    # cache doesn't already record a dead tunnel) + a 120s in-process init
+    # join — well inside any plausible driver budget; the native line hits
+    # stdout if no device ever materializes
+    init = _init_backend()
     if "backend" not in init:
         # no device available: the native number is still a result — emit it
-        # with an error tag so the round records data instead of an rc=1
+        # with an error tag so the round records data instead of an rc=1.
+        # The kernel's phase breakdown still lands in BENCH json via a
+        # small JAX-CPU pass in a subprocess (the wedged-PJRT state of THIS
+        # process cannot be trusted to run jax).
         print(f"[bench] NO DEVICE BACKEND: {init.get('error')}", file=sys.stderr)
         _emit(
             "occ_conflict_checks_per_sec_native_cpu_64k_live_ranges",
             native_rate,
             0.0,
             error=f"device backend unavailable: {init.get('error', '?')[:500]}",
+            kernel=_cpu_phase_probe(),
         )
         os._exit(0)  # daemon init thread may be wedged in PJRT; exit hard
     backend = init["backend"]
@@ -346,16 +447,18 @@ def main() -> None:
 
 
 # Best-known configuration on TPU, committed so the default timed path needs
-# no exploratory compiles at all (VERDICT r4 #1a): the LSM state confines the
-# per-batch merge to the recent level, the bucketed search amortizes batched
-# row gathers (r3/r4 measurements), the sort merge avoids TPU's serialized
-# scatter lowering.  Override with FDBTPU_SEARCH_IMPL / FDBTPU_MERGE_IMPL /
-# FDBTPU_LSM, or set BENCH_AUTOTUNE=1 to re-measure all combos on the live
-# device (the gather merge may beat sort — untimed on real hardware yet).
-BEST_KNOWN = ("bucket", "sort", True)
+# no exploratory compiles at all (VERDICT r4 #1a): the INCREMENTAL layout
+# (run append + deferred fold + the sort-scan probe) removes the measured
+# dominator — the per-batch committed-write merge — entirely; the LSM main
+# level keeps its cached sparse table, the bucketed search amortizes batched
+# row gathers (r3/r4 measurements).  Override with FDBTPU_SEARCH_IMPL /
+# FDBTPU_MERGE_IMPL / FDBTPU_LSM / FDBTPU_INCREMENTAL / FDBTPU_PALLAS, or
+# set BENCH_AUTOTUNE=1 to re-measure all combos on the live device.
+# Tuple: (search_impl, merge_impl, lsm, incremental).
+BEST_KNOWN = ("bucket", "sort", True, True)
 
 
-def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
+def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool, bool]:
     """Pick the fastest (search_impl, merge_impl, lsm) combo ON THIS DEVICE.
 
     XLA's lowering quality for scatters/gathers vs sorts differs wildly
@@ -377,11 +480,15 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
         mi = impl_from_env("merge", override=os.environ.get(
             "FDBTPU_MERGE_IMPL", BEST_KNOWN[1]))
         lsm = os.environ.get("FDBTPU_LSM", "1" if BEST_KNOWN[2] else "") == "1"
+        inc = os.environ.get(
+            "FDBTPU_INCREMENTAL", "1" if BEST_KNOWN[3] else "0"
+        ) == "1"
         print(
-            f"[bench] autotune off (best-known): search={si} merge={mi} lsm={int(lsm)}",
+            f"[bench] autotune off (best-known): search={si} merge={mi} "
+            f"lsm={int(lsm)} incremental={int(inc)}",
             file=sys.stderr,
         )
-        return si, mi, lsm
+        return si, mi, lsm, inc
 
     # (search_impl, merge_impl, lsm): lsm=True pays a rare O(CAP) compaction
     # instead of a per-batch full-state merge — the merge phase dominates on
@@ -391,18 +498,19 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
     # time-boxed autotune (flaky tunnel insurance) that stops early still
     # lands on a good configuration.
     combos = [
-        ("bucket", "gather", True),
-        ("bucket", "sort", True),
-        ("sort", "gather", True),
-        ("bucket", "gather", False),
-        ("bucket", "sort", False),
-        ("bucket", "scatter", True),
-        ("sort", "sort", False),
+        ("bucket", "sort", True, True),     # incremental + cached-table main
+        ("sort", "sort", True, True),       # exact sort search, incremental
+        ("bucket", "sort", False, True),    # incremental over flat main
+        ("bucket", "gather", True, False),  # legacy per-batch merges below
+        ("bucket", "sort", True, False),
+        ("sort", "gather", True, False),
+        ("bucket", "sort", False, False),
+        ("bucket", "scatter", True, False),
     ]
     budget_s = float(os.environ.get("BENCH_AUTOTUNE_BUDGET_S", "900"))
     t_start = time.perf_counter()
     results = {}
-    for si, mi, lsm in combos:
+    for si, mi, lsm, inc in combos:
         if results and time.perf_counter() - t_start > budget_s:
             print("[bench] autotune budget exhausted; using best so far",
                   file=sys.stderr)
@@ -412,6 +520,7 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
                 max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
                 search_impl=si, merge_impl=mi,
                 lsm=lsm, recent_capacity=REC_CAP,
+                incremental=inc, run_slots=8, run_capacity=1 << 14,
             )
             for b in prefill[:2]:
                 dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
@@ -428,23 +537,27 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool]:
                 dev.resolve_arrays(v, *args, sync=False)
             dev.check_pipelined()  # scalar fetch = completion barrier
             dt = time.perf_counter() - t0
-            results[(si, mi, lsm)] = dt
+            results[(si, mi, lsm, inc)] = dt
             print(
-                f"[bench] autotune search={si:<6} merge={mi:<7} lsm={int(lsm)}: "
-                f"{dt * 1e3 / 2:.1f} ms/batch",
+                f"[bench] autotune search={si:<6} merge={mi:<7} lsm={int(lsm)} "
+                f"inc={int(inc)}: {dt * 1e3 / 2:.1f} ms/batch",
                 file=sys.stderr,
             )
         except Exception as e:  # noqa: BLE001 — a combo failing is data
-            print(f"[bench] autotune {si}/{mi}/lsm={int(lsm)} FAILED: {e!r}",
-                  file=sys.stderr)
+            print(
+                f"[bench] autotune {si}/{mi}/lsm={int(lsm)}/inc={int(inc)} "
+                f"FAILED: {e!r}",
+                file=sys.stderr,
+            )
     if not results:
-        return "sort", "sort", True
-    (si, mi, lsm) = min(results, key=results.get)
+        return "sort", "sort", True, True
+    (si, mi, lsm, inc) = min(results, key=results.get)
     print(
-        f"[bench] autotune winner: search={si} merge={mi} lsm={int(lsm)}",
+        f"[bench] autotune winner: search={si} merge={mi} lsm={int(lsm)} "
+        f"inc={int(inc)}",
         file=sys.stderr,
     )
-    return si, mi, lsm
+    return si, mi, lsm, inc
 
 
 def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
@@ -453,17 +566,25 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet
 
-    search_impl, merge_impl, lsm = _autotune(backend, prefill, timed, pool_words)
+    search_impl, merge_impl, lsm, incremental = _autotune(
+        backend, prefill, timed, pool_words
+    )
 
     # ---------------- device ----------------
     dev = DeviceConflictSet(
         max_key_bytes=MAX_KEY_BYTES, capacity=CAP,
         search_impl=search_impl, merge_impl=merge_impl,
         lsm=lsm, recent_capacity=REC_CAP,
+        incremental=incremental, run_slots=8, run_capacity=1 << 14,
     )
     for b in prefill:
         dev.resolve_arrays(b["version"], *device_pack(pool_words, b, _bucket))
-    if lsm:
+    if getattr(dev, "_incremental", False):
+        # compile the deferred-fold kernel OUTSIDE the timed window and
+        # start the timed stream with empty run slots (compactions that
+        # fire mid-stream are still timed — the honest amortized cost)
+        dev._compact_runs()
+    elif lsm:
         # compile the compaction kernel OUTSIDE the timed window and start
         # the timed stream with an empty recent level (compactions that fire
         # mid-stream are still timed — that's the honest amortized cost)
@@ -508,13 +629,18 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
 
     # ---------------- kernel counters (observability PR) ----------------
     # a short SYNC pass: each batch's wall time is individually observable
-    # (the pipelined headline stream is not), giving honest p50/p99
+    # (the pipelined headline stream is not), giving honest p50/p99 — and,
+    # with phase timing flipped on for just these batches, the per-phase
+    # sort/scan/merge split (each phase its own dispatch + barrier; the
+    # pipelined headline stream above stayed fused)
     sync_ms = []
+    dev._phase_timing = True
     for b in post:
         args = device_pack(pool_words, b, _bucket)
         t0 = time.perf_counter()
         dev.resolve_arrays(b["version"], *args)
         sync_ms.append((time.perf_counter() - t0) * 1e3)
+    dev._phase_timing = False
     snap = dev.kernel_stats()
     kernel = {
         "occupancy": round(snap["occupancy"], 4),
@@ -526,7 +652,19 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
         "resolve_ms_p50": round(float(np.percentile(sync_ms, 50)), 2),
         "resolve_ms_p99": round(float(np.percentile(sync_ms, 99)), 2),
         "pipelined_ms_per_batch": round(device_s * 1e3 / len(timed), 2),
+        # incremental-merge proof: every timed batch appends a run
+        # (runs_appended) instead of rewriting state (full_merges == 0 on
+        # the incremental path), with bounded deferred compactions
+        "runs_appended": snap["runs_appended"],
+        "full_merges": snap["full_merges"],
+        "incremental": bool(getattr(dev, "_incremental", False)),
+        "probe_impl": getattr(dev, "_probe_impl", "?"),
     }
+    if getattr(dev, "_incremental", False):
+        # only the incremental path honors _phase_timing; a legacy-config
+        # run must not report a zeroed split as a measured one
+        kernel["phase"] = {k: round(v, 2) for k, v in snap["phase"].items()}
+        kernel["phase_backend"] = backend
     print(f"[bench] kernel counters: {kernel}", file=sys.stderr)
 
     _emit(

@@ -273,8 +273,33 @@ class DRAgent:
             await self.loop.delay(0.1, TaskPriority.COORDINATION)
         for p in gen.proxies:
             p.locked = b"dr-failover"
-        tr = pri_db.create_transaction()
-        final = await tr.get_read_version()
+        # version-consistency with the lock: the lock gate is checked at
+        # batch ENTRY, so a batch already past it can still commit at a
+        # version above whatever read version we sample now — and a commit
+        # above `final` would survive on the primary only (dropped from the
+        # secondary after wait_applied_to + stop).  Drain the commit plane
+        # first (the rebalance barrier discipline: pause + wait for
+        # in-flight batches), THEN read `final`; with the plane empty and
+        # the lock armed, no commit above `final` can ever exist.
+        for p in gen.proxies:
+            p.pause_commits()
+        try:
+            drain_deadline = min(deadline, self.loop.now() + 10.0)
+            while any(p.inflight_batches for p in gen.proxies):
+                if self.loop.now() >= drain_deadline:
+                    from ..runtime.core import TimedOut
+
+                    raise TimedOut("primary commit plane never drained")
+                await self.loop.delay(0.005, TaskPriority.COORDINATION)
+            tr = pri_db.create_transaction()
+            final = await tr.get_read_version()
+        finally:
+            # disarm the barrier refcount (the lock flag alone keeps
+            # refusing user commits); leaving it held would wedge a later
+            # unlock-and-resume of this primary
+            for p in gen.proxies:
+                p.resume_commits()
+        testcov("dr.failover_drained")
         await self.wait_applied_to(final, timeout)
         await self.stop(unlock_secondary=True)
         testcov("dr.failover")

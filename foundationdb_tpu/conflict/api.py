@@ -64,7 +64,18 @@ class KernelStats:
     only and never feed back into simulation behavior, so determinism is
     unaffected.  `pack_s` is TxInfo→tensor/ABI marshalling, `resolve_s` the
     backend check itself, `merge_s` state maintenance outside the check
-    (device GC/compaction kernels; CPU removeBefore)."""
+    (device GC/compaction kernels; CPU removeBefore).
+
+    The per-phase splits (`sort_s`/`scan_s`/`append_s`/`compact_s`) mirror
+    the device kernel's sort-scan decomposition (docs/KERNEL.md): sort =
+    rank/sort-merge of the batch against the state, scan = the fused
+    history + run-probe + intra-batch check, append = the incremental run
+    append (the merge phase that replaced the per-batch full re-sort),
+    compact = deferred run→main folds.  They are populated when the backend
+    runs with phase timing on (FDBTPU_PHASE_TIMING=1, or profile_kernel.py
+    --phase / bench.py's post pass) and stay zero otherwise — splitting a
+    fused kernel requires per-phase dispatch barriers that the hot path must
+    not pay."""
 
     backend: str = "?"
     batches: int = 0
@@ -73,13 +84,19 @@ class KernelStats:
     pack_s: float = 0.0
     resolve_s: float = 0.0
     merge_s: float = 0.0
+    sort_s: float = 0.0         # phase: state rank / sort-merge
+    scan_s: float = 0.0         # phase: history + run probe + intra-batch
+    append_s: float = 0.0       # phase: incremental run append
+    compact_s: float = 0.0      # phase: deferred run/recent→main folds
     real_rows: int = 0          # live read+write rows fed to the check
     padded_rows: int = 0        # rows after power-of-two bucketing
     recompiles: int = 0         # distinct static-shape combos jitted
     search_fallbacks: int = 0   # bucketed search replayed at full depth
-    compactions: int = 0        # LSM recent→main folds
+    compactions: int = 0        # LSM recent→main + deferred run folds
     gc_calls: int = 0
     rows_reclaimed: int = 0     # boundaries freed by GC/compaction
+    runs_appended: int = 0      # incremental merge: batches appended as runs
+    full_merges: int = 0        # legacy path: full per-batch state rewrites
 
     def __post_init__(self) -> None:
         # per-batch resolve-time reservoir for p50/p99 (deterministic
@@ -113,9 +130,17 @@ class KernelStats:
             "gc_calls": self.gc_calls,
             "rows_reclaimed": self.rows_reclaimed,
             "node_count": node_count,
+            "runs_appended": self.runs_appended,
+            "full_merges": self.full_merges,
             "pack_ms": self.pack_s * 1e3,
             "resolve_ms": self.resolve_s * 1e3,
             "merge_ms": self.merge_s * 1e3,
+            "phase": {
+                "sort_ms": self.sort_s * 1e3,
+                "scan_ms": self.scan_s * 1e3,
+                "merge_ms": self.append_s * 1e3,
+                "compact_ms": self.compact_s * 1e3,
+            },
             "resolve_ms_p50": self.resolve_sample.percentile(0.5) * 1e3,
             "resolve_ms_p99": self.resolve_sample.percentile(0.99) * 1e3,
         }

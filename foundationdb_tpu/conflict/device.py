@@ -48,6 +48,12 @@ tensors so every phase is a static-shape vectorized op:
 All-integer, no floating point, deterministic: the abort set is a pure
 function of the batch, so the jax CPU backend reproduces TPU verdicts
 bit-for-bit (simulation parity, SURVEY.md §7 "hard parts").
+
+The DEFAULT state layout is now INCREMENTAL (run append + deferred k-way
+merge + the Pallas sort-scan probe in conflict/pallas_kernel.py — see the
+"Incremental (run-append) state" section below and docs/KERNEL.md); the
+per-batch full-state merge documented above remains the automatic fallback
+(FDBTPU_INCREMENTAL=0) and the insert path used at compaction time.
 """
 
 from __future__ import annotations
@@ -63,6 +69,7 @@ import numpy as np
 from .. import keys as keymod
 from ..ops.rmq import I32_MAX, _levels, build_sparse_table, query_sparse_table
 from ..ops.search import lex_less
+from . import pallas_kernel
 from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
 from ..runtime.coverage import testcov
 
@@ -856,6 +863,247 @@ _resolve_lsm_kernel = functools.partial(
 _compact_kernel = functools.partial(jax.jit, static_argnames=("cap",))(compact_lsm)
 
 
+# ---------------------------------------------------------------------------
+# Incremental (run-append) state: the per-batch committed-write merge was the
+# kernel's measured dominator on TPU (52.8 of ~57 ms/batch, round-4
+# profiling) because it rewrote the full step function every batch.  The
+# incremental layout makes the merge an APPEND: each batch's committed
+# writes become ONE sorted, disjoint interval run at a single commit-version
+# offset:
+#
+#   runs_b/runs_e  uint32[K, RUN_CAP, W]   per-slot interval begins/ends
+#                                          (sentinel-padded; ends sorted too
+#                                          because intervals are disjoint)
+#   runs_ver       int32[K]                commit offset per slot (0 = dead)
+#
+# The history check gains a run PROBE — the sort-scan conflict kernel in
+# conflict/pallas_kernel.py (Pallas on TPU, interpret on CPU for parity,
+# vmapped-XLA fallback) — and the deferred k-way merge folds all runs into
+# the main step function only when the K slots fill (compact threshold),
+# via compact_lsm: each run IS a step function (ver over its intervals, 0
+# elsewhere), so the fold is the existing max-compose.
+
+
+def _union_intervals(wb, we, w_ins, *, run_cap: int):
+    """Canonical disjoint interval union of the committed writes, compacted
+    to the front and sentinel-padded to run_cap — the payload the
+    incremental path appends as one run.  ONE 2Wn-row multiword sort finds
+    coverage transitions (begins sort before equal ends so adjacent
+    intervals coalesce), then two stable 1-bit sorts compact the begin/end
+    rows; pairwise aligned by construction (the j-th begin opens the
+    interval the j-th end closes).  Returns (u_b, u_e)."""
+    Wn, W = wb.shape
+    n = 2 * Wn
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
+    live = jnp.concatenate([w_ins, w_ins])
+    rows = jnp.concatenate([wb, we], axis=0)
+    rows = jnp.where(live[:, None], rows, sent_row[None, :])
+    tie = jnp.concatenate(
+        [jnp.zeros(Wn, jnp.uint32), jnp.ones(Wn, jnp.uint32)]
+    )
+    delta = jnp.where(
+        live,
+        jnp.concatenate([jnp.ones(Wn, jnp.int32), jnp.full(Wn, -1, jnp.int32)]),
+        0,
+    )
+    ops = tuple(rows[:, w] for w in range(W)) + (tie, delta)
+    srt = jax.lax.sort(ops, num_keys=W + 1)
+    srows = jnp.stack(srt[:W], axis=1)
+    cov = jnp.cumsum(srt[W + 1])
+    prev = jnp.concatenate([jnp.zeros(1, jnp.int32), cov[:-1]])
+    is_beg = (cov > 0) & (prev <= 0)
+    is_end = (cov <= 0) & (prev > 0)
+
+    def compact(mask):
+        mrows = jnp.where(mask[:, None], srows, sent_row[None, :])
+        ops2 = ((~mask).astype(jnp.uint32),) + tuple(
+            mrows[:, w] for w in range(W)
+        )
+        s2 = jax.lax.sort(ops2, num_keys=1, is_stable=True)
+        return jnp.stack(s2[1 : 1 + W], axis=1)
+
+    u_b, u_e = compact(is_beg), compact(is_end)
+    if n < run_cap:
+        pad = jnp.broadcast_to(sent_row, (run_cap - n, W))
+        u_b = jnp.concatenate([u_b, pad], axis=0)
+        u_e = jnp.concatenate([u_e, pad], axis=0)
+    return u_b[:run_cap], u_e[:run_cap]
+
+
+def inc_search(ks, bucket_idx, count, rb, re_, r_tx,
+               *, search_iters: int = FAST_SEARCH_ITERS,
+               search_impl: str = "bucket"):
+    """Phase "sort": rank the READ queries against the main level.  The
+    incremental path never needs write ranks (nothing merges into main per
+    batch), so the write query classes are zero-size.  Returns
+    (g_lo, g_hi, converged)."""
+    W = ks.shape[1]
+    r_ok = r_tx >= 0
+    empty = jnp.zeros((0, W), jnp.uint32)
+    eb = jnp.zeros((0,), bool)
+    if search_impl == "sort":
+        g_lo, g_hi, _wr, _wer, conv = phase_search_sort(
+            ks, count, rb, re_, empty, empty, r_ok, eb
+        )
+    else:
+        g_lo, g_hi, _wr, _wer, conv = phase_search(
+            ks, bucket_idx, count, rb, re_, empty, empty, r_ok, eb,
+            search_iters,
+        )
+    return g_lo, g_hi, conv
+
+
+def inc_check(hist_base, g_lo, g_hi, rb, re_, r_tx, wb, we, w_tx,
+              snap, active, runs_b, runs_e, runs_ver,
+              *, n_txn: int, probe_impl: str, from_table: bool):
+    """Phase "scan": the fused conflict check — main-level history (from
+    gap versions or a prebuilt LSM sparse table), the sort-scan run probe
+    (pallas_kernel.run_conflicts), and the intra-batch fixpoint.  Returns
+    (verdict, w_ins)."""
+    B = n_txn
+    r_ok = r_tx >= 0
+    r_idx = jnp.clip(r_tx, 0, B - 1)
+    w_ok = (w_tx >= 0) & ~_is_sentinel(wb)
+    w_idx = jnp.clip(w_tx, 0, B - 1)
+    if from_table:
+        hist = history_from_table(hist_base, g_lo, g_hi, snap, r_idx, r_ok, B)
+    else:
+        hist = phase_history(hist_base, g_lo, g_hi, snap, r_idx, r_ok, B)
+    run_r = pallas_kernel.run_conflicts(
+        rb, re_, snap[r_idx], r_ok, runs_b, runs_e, runs_ver, impl=probe_impl
+    )
+    hist = hist | (
+        jnp.zeros(B, jnp.int32).at[r_idx].add((r_ok & run_r).astype(jnp.int32))
+        > 0
+    )
+    intra, _n_iters = phase_intra(
+        rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active, hist, B
+    )
+    committed = active & ~hist & ~intra
+    verdict = jnp.where(
+        active,
+        jnp.where(committed, jnp.int32(Verdict.COMMITTED), jnp.int32(Verdict.CONFLICT)),
+        jnp.int32(Verdict.TOO_OLD),
+    )
+    return verdict, w_ok & committed[w_idx]
+
+
+def inc_append(runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
+               *, run_cap: int):
+    """Phase "merge": append this batch's canonical committed union as run
+    `slot` — a dynamic-update-slice of O(run_cap) rows, NOT a full-state
+    rewrite.  Returns (runs_b', runs_e', runs_ver')."""
+    u_b, u_e = _union_intervals(wb, we, w_ins, run_cap=run_cap)
+    new_b = jax.lax.dynamic_update_slice(runs_b, u_b[None], (slot, 0, 0))
+    new_e = jax.lax.dynamic_update_slice(runs_e, u_e[None], (slot, 0, 0))
+    return new_b, new_e, runs_ver.at[slot].set(commit_off)
+
+
+def resolve_core_inc(
+    ks, vs, bucket_idx, count,
+    runs_b, runs_e, runs_ver, slot,
+    rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    ok_in=True,
+    *, cap: int, run_cap: int, n_txn: int, n_read: int, n_write: int,
+    search_iters: int = FAST_SEARCH_ITERS,
+    search_impl: str = "bucket",
+    probe_impl: str = "xla",
+):
+    """Incremental twin of resolve_core: main level is READ-ONLY per batch
+    (searched for history only), committed writes append as a run, and the
+    run probe covers everything main hasn't absorbed yet.  Returns
+    (verdict, runs_b', runs_e', runs_ver', converged, ok)."""
+    g_lo, g_hi, conv = inc_search(
+        ks, bucket_idx, count, rb, re_, r_tx,
+        search_iters=search_iters, search_impl=search_impl,
+    )
+    verdict, w_ins = inc_check(
+        vs, g_lo, g_hi, rb, re_, r_tx, wb, we, w_tx, snap, active,
+        runs_b, runs_e, runs_ver,
+        n_txn=n_txn, probe_impl=probe_impl, from_table=False,
+    )
+    new_b, new_e, new_ver = inc_append(
+        runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
+        run_cap=run_cap,
+    )
+    return verdict, new_b, new_e, new_ver, conv, ok_in & conv
+
+
+def resolve_core_inc_lsm(
+    ks, hist_tab, bucket_idx, count,
+    runs_b, runs_e, runs_ver, slot,
+    rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    ok_in=True,
+    *, cap: int, run_cap: int, n_txn: int, n_read: int, n_write: int,
+    search_iters: int = FAST_SEARCH_ITERS,
+    search_impl: str = "bucket",
+    probe_impl: str = "xla",
+):
+    """LSM twin of resolve_core_inc: main history from the CACHED sparse
+    table (rebuilt only at compaction); the run layer plays the recent
+    level's role with appends instead of per-batch sort-merges."""
+    g_lo, g_hi, conv = inc_search(
+        ks, bucket_idx, count, rb, re_, r_tx,
+        search_iters=search_iters, search_impl=search_impl,
+    )
+    verdict, w_ins = inc_check(
+        hist_tab, g_lo, g_hi, rb, re_, r_tx, wb, we, w_tx, snap, active,
+        runs_b, runs_e, runs_ver,
+        n_txn=n_txn, probe_impl=probe_impl, from_table=True,
+    )
+    new_b, new_e, new_ver = inc_append(
+        runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
+        run_cap=run_cap,
+    )
+    return verdict, new_b, new_e, new_ver, conv, ok_in & conv
+
+
+def run_to_step(u_b, u_e, ver):
+    """View one run as a step function: boundaries = interleaved begin/end
+    keys (sorted, since b_0 < e_0 < b_1 < ...), gap values = ver over the
+    run's intervals and 0 elsewhere.  Feeds compact_lsm directly — the
+    deferred k-way merge is the existing two-level max-compose, applied
+    once per live run at compaction time."""
+    rcap, W = u_b.shape
+    rows = jnp.stack([u_b, u_e], axis=1).reshape(2 * rcap, W)
+    beg_live = ~_is_sentinel(u_b)
+    vals = jnp.stack(
+        [
+            jnp.where(beg_live, ver, 0).astype(jnp.int32),
+            jnp.zeros(rcap, jnp.int32),
+        ],
+        axis=1,
+    ).reshape(2 * rcap)
+    return rows, vals
+
+
+_inc_statics = (
+    "cap", "run_cap", "n_txn", "n_read", "n_write", "search_iters",
+    "search_impl", "probe_impl",
+)
+_resolve_inc_kernel = functools.partial(
+    jax.jit, static_argnames=_inc_statics
+)(resolve_core_inc)
+_resolve_inc_lsm_kernel = functools.partial(
+    jax.jit, static_argnames=_inc_statics
+)(resolve_core_inc_lsm)
+
+# split-phase twins for FDBTPU_PHASE_TIMING=1: each phase is its own
+# dispatch with a completion barrier, so sort/scan/merge wall times are
+# individually observable (profiling mode only — the fused kernel stays
+# the hot path)
+_inc_search_kernel = functools.partial(
+    jax.jit, static_argnames=("search_iters", "search_impl")
+)(inc_search)
+_inc_check_kernel = functools.partial(
+    jax.jit, static_argnames=("n_txn", "probe_impl", "from_table")
+)(inc_check)
+_inc_append_kernel = functools.partial(
+    jax.jit, static_argnames=("run_cap",)
+)(inc_append)
+_run_step_kernel = jax.jit(run_to_step)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _gc_lsm_kernel(vs, tab, rec_vs, off):
     """remove_before for the LSM levels: range-max commutes with the
@@ -949,6 +1197,10 @@ class DeviceConflictSet(ConflictSet):
         search_impl: str | None = None,  # None: FDBTPU_SEARCH_IMPL env or "sort"
         lsm: bool | None = None,         # None: FDBTPU_LSM env ("1") or False
         recent_capacity: int = 1 << 13,  # LSM recent-level capacity
+        incremental: bool | None = None,  # None: FDBTPU_INCREMENTAL env, on
+        run_slots: int = 8,              # K: deferred-merge compaction threshold
+        run_capacity: int = 1 << 12,     # per-run interval capacity (auto-grows)
+        pallas: str | None = None,       # probe override: auto|tpu|interpret|off
     ) -> None:
         self._merge_impl = impl_from_env("merge", merge_impl)
         self._search_impl = impl_from_env("search", search_impl)
@@ -957,6 +1209,19 @@ class DeviceConflictSet(ConflictSet):
         self._lsm = (
             os.environ.get("FDBTPU_LSM", "") == "1" if lsm is None else lsm
         )
+        # incremental run-append merge is the default; the per-batch
+        # full-state merge stays as the opt-out fallback (FDBTPU_INCREMENTAL=0)
+        self._incremental = (
+            os.environ.get("FDBTPU_INCREMENTAL", "1") == "1"
+            if incremental is None
+            else incremental
+        )
+        # capability probe: Pallas-on-TPU when available, interpret on
+        # request (CPU parity tests), XLA fallback otherwise
+        self._probe_impl = pallas_kernel.pallas_mode(pallas) or "xla"
+        self._K = run_slots
+        self._run_cap = run_capacity
+        self._phase_timing = os.environ.get("FDBTPU_PHASE_TIMING", "") == "1"
         self._rec_iters = _rec_search_iters()
         self._max_key_bytes = max_key_bytes
         self._W = keymod.num_words(max_key_bytes)
@@ -1004,6 +1269,37 @@ class DeviceConflictSet(ConflictSet):
             # fresh recent level
             self._tab = build_sparse_table(self._vs, jnp.maximum, 0)
             self._init_recent(self._rec_cap)
+        if self._incremental and not hasattr(self, "_runs_b"):
+            # fresh construction only — a main-level regrow must not drop
+            # the appended-but-uncompacted runs
+            self._init_runs(self._run_cap)
+
+    def _init_runs(self, run_cap: int) -> None:
+        W = self._W
+        run_cap = _bucket(run_cap)  # kernel stride math wants a power of two
+        self._run_cap = run_cap
+        shape = (self._K, run_cap, W)
+        self._runs_b = jnp.full(shape, _SENT_WORD, dtype=jnp.uint32)
+        self._runs_e = jnp.full(shape, _SENT_WORD, dtype=jnp.uint32)
+        self._runs_ver = jnp.zeros(self._K, jnp.int32)
+        self._n_runs = 0
+        self._run_rows_ub = 0   # upper bound on live run rows (node_count)
+
+    def _grow_runs(self, new_cap: int) -> None:
+        """Sentinel-pad every run slot to new_cap (forces a stream sync:
+        the np round trip waits for in-flight appends, which is exactly the
+        safe point to reshape)."""
+        K, W = self._K, self._W
+        b = np.asarray(self._runs_b)
+        e = np.asarray(self._runs_e)
+        old = b.shape[1]
+        nb = np.full((K, new_cap, W), _SENT_WORD, dtype=np.uint32)
+        ne = np.full((K, new_cap, W), _SENT_WORD, dtype=np.uint32)
+        nb[:, :old] = b
+        ne[:, :old] = e
+        self._run_cap = new_cap
+        self._runs_b = jnp.asarray(nb)
+        self._runs_e = jnp.asarray(ne)
 
     def _init_recent(self, rec_cap: int) -> None:
         W = self._W
@@ -1028,9 +1324,15 @@ class DeviceConflictSet(ConflictSet):
     def boundary_count(self) -> int:
         if self._count is None:
             self._count = int(self._dev_count)
+        n = self._count
         if self._lsm:
-            return self._count + int(self._rec_dev_count)
-        return self._count
+            n += int(self._rec_dev_count)
+        if self._incremental:
+            # run rows are host-tracked as an upper bound (2*Wn per append);
+            # the exact union sizes live on device and fetching them would
+            # sync a pipelined stream for a status scrape
+            n += self._run_rows_ub
+        return n
 
     @property
     def node_count(self) -> int:
@@ -1125,6 +1427,12 @@ class DeviceConflictSet(ConflictSet):
         commit_off = np.int32(self._offset(commit_version))
         t0 = time.perf_counter()
 
+        if self._incremental:
+            return self._resolve_arrays_inc(
+                commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                sync, Bp, R, Wn, commit_off,
+            )
+
         if self._lsm:
             return self._resolve_arrays_lsm(
                 commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
@@ -1165,6 +1473,7 @@ class DeviceConflictSet(ConflictSet):
             self._count_ub += 2 * Wn
             self._pipelined_since_check += 1
             self._last_commit = commit_version
+            self.stats.full_merges += 1
             self._note_rows(rtv, wtv, R, Wn)
             self._note_batch(t0, active_p, None)  # dispatch time only
             return verdict
@@ -1205,6 +1514,7 @@ class DeviceConflictSet(ConflictSet):
                 self._dev_count = new_count
                 self._bidx = new_bidx
                 self._last_commit = commit_version
+                self.stats.full_merges += 1
                 break
             # capacity overflow: the merge dropped boundaries — regrow from
             # the pre-batch state (still valid: the kernel does not donate
@@ -1256,6 +1566,7 @@ class DeviceConflictSet(ConflictSet):
             self._rec_count_ub += 2 * Wn
             self._pipelined_since_check += 1
             self._last_commit = commit_version
+            self.stats.full_merges += 1
             self._note_rows(rtv, wtv, R, Wn)
             self._note_batch(t0, active_p, None)  # dispatch time only
             return verdict
@@ -1298,12 +1609,174 @@ class DeviceConflictSet(ConflictSet):
         self._rec_dev_count = jnp.int32(nrc_i)
         self._rec_count_ub = nrc_i
         self._last_commit = commit_version
+        self.stats.full_merges += 1
         v_np = np.asarray(verdict)
         self._note_rows(rtv, wtv, R, Wn)
         self._note_batch(
             t0, active_p, v_np if isinstance(active_p, np.ndarray) else None
         )
         return v_np
+
+    # -- incremental (run-append) path ---------------------------------------
+    def _resolve_arrays_inc(
+        self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+        sync, Bp, R, Wn, commit_off,
+    ):
+        """Incremental resolve: main level is read-only per batch, committed
+        writes append as run `self._n_runs`, compaction (the deferred k-way
+        merge) fires host-side when the K slots fill.  Works for both the
+        flat layout (history table rebuilt per batch from vs) and the LSM
+        layout (cached table).  All run bookkeeping is host-deterministic:
+        appends cannot overflow (run_cap >= 2*Wn by construction), so the
+        pipelined path defers only search convergence."""
+        t0 = time.perf_counter()
+        if 2 * Wn > self._run_cap:
+            self._grow_runs(_bucket(2 * Wn))
+        if self._n_runs >= self._K:
+            self._compact_runs()
+        slot = jnp.int32(self._n_runs)
+        kernel = _resolve_inc_lsm_kernel if self._lsm else _resolve_inc_kernel
+        hist_base = self._tab if self._lsm else self._vs
+        statics = dict(
+            cap=self._cap, run_cap=self._run_cap, n_txn=Bp, n_read=R,
+            n_write=Wn, search_impl=self._search_impl,
+            probe_impl=self._probe_impl,
+        )
+
+        def dispatch(ok_in, iters):
+            self._note_shape(
+                ("inc", self._lsm, self._cap, self._run_cap, self._K,
+                 Bp, R, Wn, iters, self._search_impl, self._probe_impl)
+            )
+            return kernel(
+                self._ks, hist_base, self._bidx, self._dev_count,
+                self._runs_b, self._runs_e, self._runs_ver, slot,
+                rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
+                ok_in, search_iters=iters, **statics,
+            )
+
+        if not sync:
+            verdict, nb, ne, nv, _conv, ok = dispatch(
+                self._dev_ok, min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+            )
+            self._runs_b, self._runs_e, self._runs_ver = nb, ne, nv
+            self._dev_ok = ok
+            self._n_runs += 1
+            self._run_rows_ub += 2 * Wn
+            self._pipelined_since_check += 1
+            self._last_commit = commit_version
+            self.stats.runs_appended += 1
+            self._note_rows(rtv, wtv, R, Wn)
+            self._note_batch(t0, active_p, None)  # dispatch time only
+            return verdict
+
+        if self._phase_timing:
+            verdict, nb, ne, nv = self._resolve_inc_timed(
+                rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
+                slot, hist_base, statics,
+            )
+        else:
+            iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+            while True:
+                verdict, nb, ne, nv, conv, _ok = dispatch(
+                    jnp.asarray(True), iters
+                )
+                if bool(conv):
+                    break
+                self.search_fallbacks += 1
+                self.stats.search_fallbacks += 1
+                testcov("kernel.search_fallback")
+                iters = _levels(self._cap) + 1
+        self._runs_b, self._runs_e, self._runs_ver = nb, ne, nv
+        self._n_runs += 1
+        self._run_rows_ub += 2 * Wn
+        self._last_commit = commit_version
+        self.stats.runs_appended += 1
+        v_np = np.asarray(verdict)
+        self._note_rows(rtv, wtv, R, Wn)
+        self._note_batch(
+            t0, active_p, v_np if isinstance(active_p, np.ndarray) else None
+        )
+        return v_np
+
+    def _resolve_inc_timed(
+        self, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
+        slot, hist_base, statics,
+    ):
+        """Split-phase sync resolve (FDBTPU_PHASE_TIMING=1): each phase is
+        its own dispatch + completion barrier so sort/scan/merge wall times
+        land in KernelStats individually.  Same math as the fused kernel —
+        the phases are the same traced functions."""
+        Bp = statics["n_txn"]
+        iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+        while True:
+            t = time.perf_counter()
+            g_lo, g_hi, conv = _inc_search_kernel(
+                self._ks, self._bidx, self._dev_count, rbv, rev, rtv,
+                search_iters=iters, search_impl=self._search_impl,
+            )
+            jax.block_until_ready(g_lo)
+            self.stats.sort_s += time.perf_counter() - t
+            if bool(conv):
+                break
+            self.search_fallbacks += 1
+            self.stats.search_fallbacks += 1
+            testcov("kernel.search_fallback")
+            iters = _levels(self._cap) + 1
+        t = time.perf_counter()
+        verdict, w_ins = _inc_check_kernel(
+            hist_base, g_lo, g_hi, rbv, rev, rtv, wbv, wev, wtv,
+            snap_p, active_p, self._runs_b, self._runs_e, self._runs_ver,
+            n_txn=Bp, probe_impl=self._probe_impl, from_table=self._lsm,
+        )
+        jax.block_until_ready(verdict)
+        self.stats.scan_s += time.perf_counter() - t
+        t = time.perf_counter()
+        nb, ne, nv = _inc_append_kernel(
+            self._runs_b, self._runs_e, self._runs_ver, slot,
+            wbv, wev, w_ins, commit_off, run_cap=self._run_cap,
+        )
+        jax.block_until_ready(nv)
+        self.stats.append_s += time.perf_counter() - t
+        return verdict, nb, ne, nv
+
+    def _compact_runs(self) -> None:
+        """The deferred k-way merge: fold every appended run (each a step
+        function at one commit version) into the main level via the
+        existing max-compose (compact_lsm), regrowing main when a fold's
+        union outgrows it.  The ONLY full-state sorts on the incremental
+        path happen here — once per K batches, not per batch."""
+        if self._n_runs == 0:
+            return
+        t0 = time.perf_counter()
+        before = self._count_ub + self._run_rows_ub
+        nc_i = self._count_ub
+        for s in range(self._n_runs):
+            rows, vals = _run_step_kernel(
+                self._runs_b[s], self._runs_e[s], self._runs_ver[s]
+            )
+            while True:
+                nk, nv, nc, nb, nt = _compact_kernel(
+                    self._ks, self._vs, rows, vals, cap=self._cap
+                )
+                nc_i = int(nc)
+                if nc_i <= self._cap:
+                    break
+                self._grow_main(max(self._cap * 2, _bucket(nc_i)))
+            self._ks, self._vs, self._bidx = nk, nv, nb
+            if self._lsm:
+                self._tab = nt
+        self._count = nc_i
+        self._count_ub = nc_i
+        self._dev_count = jnp.int32(nc_i)
+        self._init_runs(self._run_cap)
+        self.compactions += 1
+        self.stats.compactions += 1
+        self.stats.rows_reclaimed += max(0, before - nc_i)
+        dt = time.perf_counter() - t0
+        self.stats.compact_s += dt
+        self.stats.merge_s += dt
+        testcov("kernel.run_compaction")
 
     def _compact(self) -> None:
         """Fold recent into main; regrow main if the union does not fit."""
@@ -1394,6 +1867,13 @@ class DeviceConflictSet(ConflictSet):
                 )
             else:
                 self._ks, self._vs = _gc_kernel(self._ks, self._vs, np.int32(off))
+            if self._incremental:
+                # a run whose version falls out of the MVCC window clamps
+                # to 0 and can never conflict again (snapshots are >= 0) —
+                # the same dead-gap semantics as the step-function clamp
+                self._runs_ver = jnp.maximum(
+                    self._runs_ver - jnp.int32(off), 0
+                )
             self._base = version
             self.stats.gc_calls += 1
             self.stats.merge_s += time.perf_counter() - t0

@@ -1,0 +1,248 @@
+"""Pallas sort-scan conflict kernel — the committed-run probe.
+
+The device backend's measured dominator was the committed-write MERGE: the
+XLA lowering rewrote the full step function every batch (52.8 of ~57 ms/batch
+at CAP=2^19, round-4 profiling).  The incremental redesign (conflict/device.py
+"runs" layout) makes the merge an APPEND: each resolved batch's committed
+write ranges become one sorted, disjoint interval *run* at a single commit
+version, and runs fold into the main step function only at deferred
+compactions.  What remains per batch is the check this kernel does — the
+sort-scan conflict core:
+
+  for each read range [rb, re) at snapshot `snap`, against each run k:
+      conflict  iff  runs_ver[k] > snap          (MVCC version-window check)
+                and  run k intersects [rb, re)   (segment-intersection scan)
+
+Because a run's intervals are sorted and DISJOINT, their end keys are sorted
+too, so the intersection test collapses to a rank + one neighbour row:
+
+      rank = |{ i : begins[i] < re }|            (sort-merge of the query
+                                                  against the run's key order)
+      intersects  iff  rank > 0  and  ends[rank-1] > rb
+
+The kernel fuses all three per (run, read-block) grid step: the run's begin
+and end key tensors live in VMEM; the rank comes from a two-level scan — a
+vectorized lexicographic count against a summary of every STRIDE-th begin
+key (the merge-path coarse partition), then a counted compare inside the
+one STRIDE-wide window the rank can occupy.  No state-sized scatters, no
+HBM gathers: everything a block touches is VMEM-resident, which is exactly
+the access pattern XLA's gather/scatter lowering denied us.
+
+Lowering chain (the capability probe, `pallas_mode`):
+
+  * "tpu"        — compiled Pallas on a real TPU backend (the production
+                   lowering; shapes here are small enough that Mosaic's
+                   (8, 128) tiling pads the W=5..9 lane dimension).
+  * "interpret"  — `pl.pallas_call(..., interpret=True)`: the same kernel
+                   body run by the Pallas interpreter on CPU.  Slow, but
+                   bit-identical — tier-1 parity tests pin the kernel's
+                   semantics to the oracle without TPU access.
+  * None         — Pallas unavailable (or FDBTPU_PALLAS=off): callers fall
+                   back to `run_conflicts_xla`, a vmapped full-depth binary
+                   search with the identical contract, so no backend or test
+                   ever depends on Pallas being importable.
+
+All-integer and deterministic, like the rest of the conflict core: the probe
+is a pure function of (reads, runs), so CPU interpret, XLA fallback and TPU
+verdicts agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+READ_BLOCK = 128    # reads per grid step (R is power-of-two bucketed, >= 16)
+SUMMARY_STRIDE = 128  # begin keys per summary window (the coarse partition)
+
+
+# ---------------------------------------------------------------------------
+# capability probe
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available() -> bool:
+    """Can `jax.experimental.pallas` be imported at all?  Cached: the probe
+    runs in every DeviceConflictSet constructor."""
+    try:
+        from jax.experimental import pallas as _pl  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 — any import failure means "no pallas"
+        return False
+
+
+def pallas_mode(override: str | None = None) -> str | None:
+    """Resolve the probe lowering: explicit override, else FDBTPU_PALLAS,
+    else auto.  Returns "tpu" | "interpret" | None (None => XLA fallback).
+
+    auto: compiled Pallas when the default backend is a TPU, XLA fallback
+    otherwise — interpret mode is a *testing* lowering (orders of magnitude
+    slower than XLA on CPU) and is never chosen implicitly.  Unknown values
+    fail loudly, the knob-parsing convention."""
+    v = override or os.environ.get("FDBTPU_PALLAS", "auto")
+    if v in ("off", "0", "none"):
+        return None
+    if not pallas_available():
+        if v in ("interpret", "tpu", "on", "1"):
+            raise RuntimeError(
+                f"FDBTPU_PALLAS={v!r} but jax.experimental.pallas is not importable"
+            )
+        return None
+    if v == "interpret":
+        return "interpret"
+    if v in ("tpu", "on", "1"):
+        return "tpu"
+    if v == "auto":
+        return "tpu" if jax.default_backend() == "tpu" else None
+    raise ValueError(
+        f"unknown FDBTPU_PALLAS value {v!r}; choose auto|tpu|interpret|off"
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared lexicographic compare (broadcasting twin of ops.search.lex_less,
+# usable inside a Pallas kernel body)
+
+
+def lex_less_b(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic a < b over the trailing word axis, broadcasting over
+    leading axes (ops.search.lex_less requires equal ranks; kernels compare
+    [QB, 1, W] against [1, N, W])."""
+    W = a.shape[-1]
+    lt = a < b
+    eq = a == b
+    out = lt[..., W - 1]
+    for w in range(W - 2, -1, -1):
+        out = lt[..., w] | (eq[..., w] & out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+
+
+def _probe_kernel(ver_ref, rb_ref, re_ref, snap_ref, rok_ref, b_ref, e_ref,
+                  out_ref, *, stride: int, run_cap: int):
+    """One (read-block, run) grid step of the sort-scan probe.
+
+    Grid is (R // READ_BLOCK, K) with the run axis MINOR, so each read
+    block's output is produced by K consecutive steps and accumulated with
+    the standard revisiting pattern (init at k == 0, OR afterwards)."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+    begins = b_ref[0]            # [run_cap, W] — this run's interval begins
+    ends = e_ref[0]              # [run_cap, W] — matching ends (also sorted)
+    rb = rb_ref[...]             # [QB, W]
+    re_ = re_ref[...]            # [QB, W]
+    snap = snap_ref[...]         # [QB]
+    rok = rok_ref[...]           # [QB] int32 0/1
+    ver = ver_ref[k]             # this run's commit-version offset (SMEM)
+
+    n_sum = run_cap // stride
+    wins = begins.reshape(n_sum, stride, begins.shape[-1])
+    summary = wins[:, 0, :]      # every stride-th begin key (merge-path posts)
+
+    # coarse scan: how many summary posts sort before re?  rank lives in
+    # window (coarse - 1); coarse == 0 means rank == 0 (begins[0] >= re).
+    coarse = jnp.sum(
+        lex_less_b(summary[None, :, :], re_[:, None, :]).astype(jnp.int32),
+        axis=1,
+    )                            # [QB]
+    w_i = jnp.clip(coarse - 1, 0, n_sum - 1)
+    window = jnp.take(wins, w_i, axis=0)        # [QB, stride, W]
+    fine = jnp.sum(
+        lex_less_b(window, re_[:, None, :]).astype(jnp.int32), axis=1
+    )
+    rank = jnp.where(coarse > 0, w_i * stride + fine, 0)
+
+    # ends are sorted (disjoint intervals), so the candidate with the
+    # largest end among begins < re is exactly ends[rank - 1]
+    e_last = jnp.take(ends, jnp.clip(rank - 1, 0, run_cap - 1), axis=0)
+    intersects = (rank > 0) & lex_less_b(rb, e_last)
+    conf = ((rok > 0) & intersects & (ver > snap)).astype(jnp.int32)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = conf
+
+    @pl.when(k > 0)
+    def _accum():
+        out_ref[...] = out_ref[...] | conf
+
+
+@functools.lru_cache(maxsize=64)
+def _build_probe(K: int, run_cap: int, W: int, R: int, interpret: bool):
+    """Compile-cache the pallas_call for one (shape, mode) combo."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qb = min(READ_BLOCK, R)
+    stride = min(SUMMARY_STRIDE, run_cap)
+    grid = (R // qb, K)
+    kernel = functools.partial(_probe_kernel, stride=stride, run_cap=run_cap)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                    # runs_ver [K]
+            pl.BlockSpec((qb, W), lambda q, k: (q, 0)),               # rb
+            pl.BlockSpec((qb, W), lambda q, k: (q, 0)),               # re
+            pl.BlockSpec((qb,), lambda q, k: (q,)),                   # snap
+            pl.BlockSpec((qb,), lambda q, k: (q,)),                   # r_ok
+            pl.BlockSpec((1, run_cap, W), lambda q, k: (k, 0, 0)),    # begins
+            pl.BlockSpec((1, run_cap, W), lambda q, k: (k, 0, 0)),    # ends
+        ],
+        out_specs=pl.BlockSpec((qb,), lambda q, k: (q,)),
+        out_shape=jax.ShapeDtypeStruct((R,), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def run_conflicts_pallas(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver,
+                         *, interpret: bool) -> jnp.ndarray:
+    """Pallas lowering of the run probe.  Returns bool[R]: read i conflicts
+    with some committed run newer than its snapshot."""
+    K, run_cap, W = runs_b.shape
+    R = rb.shape[0]
+    fn = _build_probe(K, run_cap, W, R, interpret)
+    out = fn(
+        runs_ver, rb, re_, snap_r, r_ok.astype(jnp.int32), runs_b, runs_e
+    )
+    return out > 0
+
+
+def run_conflicts_xla(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver) -> jnp.ndarray:
+    """XLA fallback with the identical contract: a vmapped full-depth
+    lower_bound per run (exact — no convergence fallback needed) plus the
+    same rank/neighbour intersection test."""
+    from ..ops.search import lex_less, lower_bound
+
+    run_cap = runs_b.shape[1]
+
+    def per_run(bs, es, ver):
+        rank = lower_bound(bs, re_)                       # int32[R]
+        e_last = jnp.take(es, jnp.clip(rank - 1, 0, run_cap - 1), axis=0)
+        intersects = (rank > 0) & lex_less(rb, e_last)
+        return intersects & (ver > snap_r)
+
+    conf = jax.vmap(per_run)(runs_b, runs_e, runs_ver)    # [K, R]
+    return r_ok & jnp.any(conf, axis=0)
+
+
+def run_conflicts(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver,
+                  *, impl: str) -> jnp.ndarray:
+    """Dispatch on the probed lowering: "tpu" | "interpret" | "xla"."""
+    if impl == "xla":
+        return run_conflicts_xla(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver)
+    if impl in ("tpu", "interpret"):
+        return run_conflicts_pallas(
+            rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver,
+            interpret=(impl == "interpret"),
+        )
+    raise ValueError(f"unknown probe impl {impl!r}; choose tpu|interpret|xla")

@@ -74,8 +74,13 @@ def _kernel_rollup(resolvers) -> dict[str, Any]:
         "batches", "txns", "aborted", "rows_real", "rows_padded",
         "recompiles", "search_fallbacks", "compactions", "gc_calls",
         "rows_reclaimed", "node_count", "pack_ms", "resolve_ms", "merge_ms",
+        "runs_appended", "full_merges",
     ):
-        out[k] = sum(p[k] for p in per)
+        out[k] = sum(p.get(k, 0) for p in per)
+    out["phase"] = {
+        k: sum(p.get("phase", {}).get(k, 0.0) for p in per)
+        for k in ("sort_ms", "scan_ms", "merge_ms", "compact_ms")
+    }
     out["abort_rate"] = out["aborted"] / out["txns"] if out["txns"] else 0.0
     out["occupancy"] = (
         out["rows_real"] / out["rows_padded"] if out["rows_padded"] else 1.0
@@ -306,6 +311,9 @@ STATUS_SCHEMA: dict = {
         "gc_calls": int,
         "rows_reclaimed": int,
         "node_count": int,
+        "runs_appended": int,
+        "full_merges": int,
+        "phase": dict,
         "resolve_ms_p50": (int, float),
         "resolve_ms_p99": (int, float),
         "per_resolver": list,

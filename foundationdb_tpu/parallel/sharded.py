@@ -48,6 +48,7 @@ if not hasattr(jax, "shard_map"):  # pre-0.4.35 jax: not yet promoted out of
     jax.shard_map = _shard_map
 
 from .. import keys as keymod
+from ..conflict import pallas_kernel
 from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
 from ..conflict.device import (
     _SENT_WORD,
@@ -57,7 +58,10 @@ from ..conflict.device import (
     impl_from_env,
     pack_batch,
     resolve_core,
+    resolve_core_inc,
+    resolve_core_inc_lsm,
     resolve_core_lsm,
+    run_to_step,
 )
 from ..ops.rmq import build_sparse_table
 from ..ops.rmq import _levels
@@ -155,6 +159,113 @@ def _sharded_resolve_lsm(
         merged, nrks[None], nrvs[None], nrbidx[None], nrcnt[None],
         all_conv, all_ok,
     )
+
+
+def _sharded_resolve_inc(
+    ks, vs, cnt, bidx,                 # main level shards (read-only here)
+    runs_b, runs_e, runs_ver,          # per-partition run shards
+    lo, hi,
+    slot, rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    ok_in,
+    *, cap, run_cap, n_txn, n_read, n_write, search_iters, search_impl,
+    probe_impl,
+):
+    """Incremental twin of _sharded_resolve: the same clip → kernel → pmin
+    shape, with the committed writes appending as a per-partition run
+    (conflict/device.py resolve_core_inc — the sort-scan probe runs per
+    shard, Pallas or XLA per the capability probe)."""
+    ks, vs, bidx = ks[0], vs[0], bidx[0]
+    lo, hi = lo[0], hi[0]
+    rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
+    wb, we, w_tx = _clip_ranges(wb, we, w_tx, lo, hi)
+    verdict, nb, ne, nv, conv, ok = resolve_core_inc(
+        ks, vs, bidx, cnt[0],
+        runs_b[0], runs_e[0], runs_ver[0], slot,
+        rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off, ok_in,
+        cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
+        n_write=n_write, search_iters=search_iters,
+        search_impl=search_impl, probe_impl=probe_impl,
+    )
+    merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
+    all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
+    all_ok = jax.lax.pmin(ok.astype(jnp.int32), RESOLVER_AXIS) > 0
+    return merged, nb[None], ne[None], nv[None], all_conv, all_ok
+
+
+def _sharded_resolve_inc_lsm(
+    ks, tab, cnt, bidx,
+    runs_b, runs_e, runs_ver,
+    lo, hi,
+    slot, rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
+    ok_in,
+    *, cap, run_cap, n_txn, n_read, n_write, search_iters, search_impl,
+    probe_impl,
+):
+    """LSM twin: main history from the cached per-partition sparse table."""
+    ks, tab, bidx = ks[0], tab[0], bidx[0]
+    lo, hi = lo[0], hi[0]
+    rb, re_, r_tx = _clip_ranges(rb, re_, r_tx, lo, hi)
+    wb, we, w_tx = _clip_ranges(wb, we, w_tx, lo, hi)
+    verdict, nb, ne, nv, conv, ok = resolve_core_inc_lsm(
+        ks, tab, bidx, cnt[0],
+        runs_b[0], runs_e[0], runs_ver[0], slot,
+        rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off, ok_in,
+        cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
+        n_write=n_write, search_iters=search_iters,
+        search_impl=search_impl, probe_impl=probe_impl,
+    )
+    merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
+    all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
+    all_ok = jax.lax.pmin(ok.astype(jnp.int32), RESOLVER_AXIS) > 0
+    return merged, nb[None], ne[None], nv[None], all_conv, all_ok
+
+
+def build_sharded_resolver_inc(
+    mesh: Mesh, *, cap: int, run_cap: int, n_txn: int, n_read: int,
+    n_write: int, search_iters: int, search_impl: str, probe_impl: str,
+    lsm: bool,
+):
+    shard = P(RESOLVER_AXIS)
+    repl = P()
+    fn = jax.shard_map(
+        functools.partial(
+            _sharded_resolve_inc_lsm if lsm else _sharded_resolve_inc,
+            cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
+            n_write=n_write, search_iters=search_iters,
+            search_impl=search_impl, probe_impl=probe_impl,
+        ),
+        mesh=mesh,
+        in_specs=(shard,) * 7 + (shard, shard) + (repl,) * 11,
+        out_specs=(repl, shard, shard, shard, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _sharded_compact_runs(ks, vs, runs_b, runs_e, runs_ver, *, cap, slots):
+    """Fold ALL run slots into each partition's main level (empty slots are
+    sentinel runs at version 0 — a no-op fold), returning the per-partition
+    fold-count maximum so the host can detect overflow and regrow.  One
+    compiled shape regardless of how many slots are live."""
+    k, v = ks[0], vs[0]
+    maxcnt = jnp.int32(0)
+    for s in range(slots):
+        rows, vals = run_to_step(runs_b[0, s], runs_e[0, s], runs_ver[0, s])
+        k, v, cnt, bidx, tab = compact_lsm(k, v, rows, vals, cap=cap)
+        maxcnt = jnp.maximum(maxcnt, cnt)
+    return k[None], v[None], cnt[None], bidx[None], tab[None], maxcnt[None]
+
+
+def build_sharded_run_compactor(mesh: Mesh, *, cap: int, slots: int):
+    shard = P(RESOLVER_AXIS)
+    fn = jax.shard_map(
+        functools.partial(_sharded_compact_runs, cap=cap, slots=slots),
+        mesh=mesh,
+        in_specs=(shard,) * 5,
+        out_specs=(shard,) * 6,
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def _sharded_compact(ks, vs, rks, rvs, *, cap):
@@ -268,6 +379,10 @@ class ShardedDeviceConflictSet(ConflictSet):
         search_impl: str | None = None,
         lsm: bool | None = None,         # None: FDBTPU_LSM env ("1") or False
         recent_capacity: int = 1 << 12,  # LSM recent level per partition
+        incremental: bool | None = None,  # None: FDBTPU_INCREMENTAL env, on
+        run_slots: int = 8,              # K: per-partition run slots
+        run_capacity: int = 1 << 10,     # per-run interval capacity
+        pallas: str | None = None,       # probe override: auto|tpu|interpret|off
     ) -> None:
         self._merge_impl = impl_from_env("merge", merge_impl)
         self._search_impl = impl_from_env("search", search_impl)
@@ -276,6 +391,14 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._lsm = (
             os.environ.get("FDBTPU_LSM", "") == "1" if lsm is None else lsm
         )
+        self._incremental = (
+            os.environ.get("FDBTPU_INCREMENTAL", "1") == "1"
+            if incremental is None
+            else incremental
+        )
+        self._probe_impl = pallas_kernel.pallas_mode(pallas) or "xla"
+        self._K = run_slots
+        self._run_cap = run_capacity
         from ..conflict.device import _rec_search_iters
 
         self._rec_iters = _rec_search_iters()
@@ -344,6 +467,37 @@ class ShardedDeviceConflictSet(ConflictSet):
                 out_shardings=self._state_sharding,
             )(self._vs)
             self._init_recent()
+        if self._incremental and not hasattr(self, "_runs_b"):
+            # fresh construction only — regrows keep uncompacted runs
+            self._init_runs(self._run_cap)
+
+    def _init_runs(self, run_cap: int) -> None:
+        from ..conflict.device import _bucket
+
+        n, W = self._n, self._W
+        run_cap = _bucket(run_cap)  # kernel stride math wants a power of two
+        self._run_cap = run_cap
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        shape = (n, self._K, run_cap, W)
+        self._runs_b = dev(np.full(shape, _SENT_WORD, dtype=np.uint32))
+        self._runs_e = dev(np.full(shape, _SENT_WORD, dtype=np.uint32))
+        self._runs_ver = dev(np.zeros((n, self._K), dtype=np.int32))
+        self._n_runs = 0
+
+    def _grow_runs(self, new_cap: int) -> None:
+        n, K, W = self._n, self._K, self._W
+        b = np.asarray(self._runs_b)
+        e = np.asarray(self._runs_e)
+        old = b.shape[2]
+        nb = np.full((n, K, new_cap, W), _SENT_WORD, dtype=np.uint32)
+        ne = np.full((n, K, new_cap, W), _SENT_WORD, dtype=np.uint32)
+        nb[:, :, :old] = b
+        ne[:, :, :old] = e
+        ver = self._runs_ver
+        self._run_cap = new_cap
+        dev = functools.partial(jax.device_put, device=self._state_sharding)
+        self._runs_b, self._runs_e = dev(nb), dev(ne)
+        self._runs_ver = ver
 
     def _init_recent(self) -> None:
         n, W, rec_cap = self._n, self._W, self._rec_cap
@@ -458,6 +612,20 @@ class ShardedDeviceConflictSet(ConflictSet):
             )
         return self._fns[key]
 
+    def _fn_inc(self, n_txn: int, n_read: int, n_write: int, search_iters: int):
+        key = (
+            "inc", self._lsm, self._cap, self._run_cap, n_txn, n_read,
+            n_write, search_iters, self._search_impl, self._probe_impl,
+        )
+        if key not in self._fns:
+            self._fns[key] = build_sharded_resolver_inc(
+                self._mesh, cap=self._cap, run_cap=self._run_cap,
+                n_txn=n_txn, n_read=n_read, n_write=n_write,
+                search_iters=search_iters, search_impl=self._search_impl,
+                probe_impl=self._probe_impl, lsm=self._lsm,
+            )
+        return self._fns[key]
+
     @property
     def capacity(self) -> int:
         return self._cap
@@ -500,6 +668,12 @@ class ShardedDeviceConflictSet(ConflictSet):
         Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
         commit_off = np.int32(self._offset(commit_version))
         fast_iters = min(FAST_SEARCH_ITERS, _levels(self._cap) + 1)
+
+        if self._incremental:
+            return self._resolve_arrays_inc(
+                commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p,
+                active_p, sync, Bp, R, Wn, commit_off, fast_iters,
+            )
 
         if self._lsm:
             return self._resolve_arrays_lsm(
@@ -571,6 +745,94 @@ class ShardedDeviceConflictSet(ConflictSet):
                 pre[4] if pre[4] is not None else np.asarray(pre[2]).astype(np.int64),
             )
         return np.asarray(verdict)
+
+    def _resolve_arrays_inc(
+        self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+        sync, Bp, R, Wn, commit_off, fast_iters,
+    ):
+        """Incremental sharded resolve: each partition appends its clipped
+        committed union as a run; the deferred fold fires host-side when the
+        K slots fill.  Run bookkeeping is host-deterministic (appends cannot
+        overflow: run_cap >= 2*Wn by construction), so pipelined mode
+        defers only search convergence — mirroring DeviceConflictSet."""
+        from ..conflict.device import _bucket
+
+        if 2 * Wn > self._run_cap:
+            self._grow_runs(_bucket(2 * Wn))
+        if self._n_runs >= self._K:
+            self._compact_runs()
+        slot = jnp.int32(self._n_runs)
+        main = (
+            (self._ks, self._tab) if self._lsm else (self._ks, self._vs)
+        )
+
+        if not sync:
+            fn = self._fn_inc(Bp, R, Wn, fast_iters)
+            verdict, nb, ne, nv, _conv, ok = fn(
+                main[0], main[1], self._dev_counts, self._bidx,
+                self._runs_b, self._runs_e, self._runs_ver,
+                self._lo, self._hi,
+                slot, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                commit_off, self._dev_ok,
+            )
+            self._runs_b, self._runs_e, self._runs_ver = nb, ne, nv
+            self._dev_ok = ok
+            self._n_runs += 1
+            self._pipelined_since_check += 1
+            self._last_commit = commit_version
+            return verdict
+
+        iters = fast_iters
+        while True:
+            fn = self._fn_inc(Bp, R, Wn, iters)
+            verdict, nb, ne, nv, conv, _ok = fn(
+                main[0], main[1], self._dev_counts, self._bidx,
+                self._runs_b, self._runs_e, self._runs_ver,
+                self._lo, self._hi,
+                slot, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
+                commit_off, self._dev_ok,
+            )
+            if bool(np.asarray(conv)):
+                break
+            self.search_fallbacks += 1
+            iters = _levels(self._cap) + 1
+        self._runs_b, self._runs_e, self._runs_ver = nb, ne, nv
+        self._n_runs += 1
+        self._last_commit = commit_version
+        return np.asarray(verdict)
+
+    def _compact_runs(self) -> None:
+        """The deferred k-way merge, per partition under shard_map: fold all
+        K slots into main (empty slots fold as no-ops — one compiled shape),
+        regrowing main when any partition's union outgrows it."""
+        if self._n_runs == 0:
+            return
+        while True:
+            key = ("compact_runs", self._cap, self._run_cap, self._K)
+            if key not in self._fns:
+                self._fns[key] = build_sharded_run_compactor(
+                    self._mesh, cap=self._cap, slots=self._K
+                )
+            nks, nvs, ncnt, nbidx, ntab, maxcnt = self._fns[key](
+                self._ks, self._vs, self._runs_b, self._runs_e, self._runs_ver
+            )
+            worst = int(np.asarray(maxcnt).max())
+            if worst <= self._cap:
+                break
+            self.regrows += 1
+            new_cap = self._cap
+            while new_cap < worst:
+                new_cap *= 2
+            self._grow_main(new_cap)
+        self._ks, self._vs, self._bidx = nks, nvs, nbidx
+        if self._lsm:
+            self._tab = ntab
+        counts = np.asarray(ncnt).astype(np.int64)
+        self._counts = counts
+        self._counts_ub = counts.copy()
+        self._dev_counts = ncnt
+        self._init_runs(self._run_cap)
+        self.compactions += 1
 
     def _resolve_arrays_lsm(
         self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,
@@ -677,4 +939,8 @@ class ShardedDeviceConflictSet(ConflictSet):
                 )
             else:
                 self._vs = _sharded_gc(self._vs, np.int32(off))
+            if self._incremental:
+                # dead runs clamp to version 0 and never conflict again
+                # (elementwise, so the output keeps the input's sharding)
+                self._runs_ver = _sharded_gc(self._runs_ver, np.int32(off))
             self._base = version

@@ -357,14 +357,36 @@ class CommitProxy:
         for d in dbg:
             g_trace_batch.add("CommitProxyServer.commitBatch.GotCommitVersion", d)
 
+        # phase 2 precondition: versionstamp offsets are client-controlled
+        # and must be validated BEFORE resolution — a malformed offset
+        # detected after phase 3 would flip the verdict while the resolvers
+        # had already merged the txn's write ranges as committed, leaving
+        # phantom conflict state that spuriously aborts later readers.
+        # Failing pre-resolve keeps the conflict set clean: the txn reaches
+        # the resolvers with EMPTY conflict ranges (nothing inserted) and
+        # its verdict is forced to CONFLICT after the min-combine.
+        from .types import versionstamp_offset_ok
+
+        bad_stamp = [
+            not all(versionstamp_offset_ok(m) for m in pc.request.mutations)
+            for pc in batch
+        ]
+        for i, bad in enumerate(bad_stamp):
+            if bad:
+                testcov("proxy.bad_versionstamp_prereresolve")
+
         # phase 2: per-resolver range split (ResolutionRequestBuilder :242)
         # using the partition map effective at THIS batch's version
         t_res = self.loop.now()
         rmap = self.rmap_at(version)
         n_res = len(self.resolvers)
         per_res: list[list[TxInfo]] = [[] for _ in range(n_res)]
-        for pc in batch:
+        for i, pc in enumerate(batch):
             t = pc.request
+            if bad_stamp[i]:
+                for r in range(n_res):
+                    per_res[r].append(TxInfo(t.read_snapshot, [], []))
+                continue
             for r in range(n_res):
                 rr = [
                     c
@@ -396,6 +418,9 @@ class CommitProxy:
             Verdict(min(int(rep.committed[i]) for rep in replies))
             for i in range(len(batch))
         ]
+        for i, bad in enumerate(bad_stamp):
+            if bad:  # pre-resolve failure: nothing was inserted for it
+                verdicts[i] = Verdict.CONFLICT
         if batch:
             self.latency["resolution"].observe(self.loop.now() - t_res)
         for d in dbg:
@@ -439,10 +464,11 @@ class CommitProxy:
                 for m in muts
             ):
                 # stamp substitution BEFORE key routing: the final key (not
-                # the placeholder) decides the shard.  A malformed offset
-                # (client-controlled input) fails ONLY this transaction —
-                # never the batch, which would cascade into a recovery loop.
-                # (Phase 5 sends its NOT_COMMITTED reply.)
+                # the placeholder) decides the shard.  Offsets were already
+                # validated pre-resolve (phase 2 precondition), so this
+                # except is defense-in-depth only — it still fails ONLY
+                # this transaction, never the batch, which would cascade
+                # into a recovery loop.  (Phase 5 sends NOT_COMMITTED.)
                 from .types import resolve_versionstamp
 
                 try:

@@ -77,6 +77,26 @@ def resolve_versionstamp(m: "Mutation", version: Version, txn_order: int) -> "Mu
     return m
 
 
+def versionstamp_offset_ok(m: "Mutation") -> bool:
+    """Pre-resolve validation of a versionstamped mutation's trailing
+    offset (client-controlled input): True iff resolve_versionstamp will
+    succeed for any (version, txn_order).  The proxy checks this BEFORE
+    the resolution phase, so a malformed offset fails only its own
+    transaction pre-resolve instead of flipping the verdict after the
+    resolvers already merged its write ranges as committed (phantom
+    conflict state that spuriously aborts later readers)."""
+    if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+        raw = m.key
+    elif m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+        raw = m.value
+    else:
+        return True
+    if len(raw) < 4:
+        return False
+    off = int.from_bytes(raw[-4:], "little")
+    return off + VERSIONSTAMP_LEN <= len(raw) - 4
+
+
 def apply_atomic(op: MutationType, old: bytes | None, operand: bytes) -> bytes:
     """Atomic-op math (fdbclient/Atomic.h semantics: operands zero-extended
     to a common length; ADD wraps little-endian)."""

@@ -5,15 +5,83 @@ shapes (CAP=2^19, R=16K, Wn=8K, W=5) so optimization attacks the measured
 dominator, mirroring skipListTest's per-phase PerfCounters
 (fdbserver/SkipList.cpp:1412-1502).
 
-Usage:  python profile_kernel.py            # real device (axon TPU)
+Usage:  python profile_kernel.py            # primitive microbench (device)
         JAX_PLATFORMS=cpu python profile_kernel.py
+        python profile_kernel.py --phase    # whole-kernel phase breakdown
+                                            # over the new KernelStats
+                                            # sort/scan/merge/compact
+                                            # counters (docs/KERNEL.md)
+
+--phase drives a real DeviceConflictSet through a synthetic stream with
+FDBTPU_PHASE_TIMING on (each phase its own dispatch + barrier) and prints
+the per-phase wall-time split plus the incremental-merge counters — the
+same numbers bench.py lands in BENCH json.  Shape knobs: PROFILE_BATCHES,
+PROFILE_TXNS, PROFILE_CAP (env).
 """
 
 from __future__ import annotations
 
+import os
+import sys
 import time
 
 import numpy as np
+
+
+def drive_phase_stream(n_batches: int, n_txns: int, cap: int,
+                       run_slots: int = 4, seed: int = 11):
+    """Shared synthetic resolve stream with phase timing on — the single
+    driver behind `profile_kernel.py --phase` AND `bench.py --cpu-phase`,
+    so the two phase reports operators compare never desynchronize.
+    Returns (DeviceConflictSet, kernel_stats snapshot)."""
+    os.environ["FDBTPU_PHASE_TIMING"] = "1"
+    from foundationdb_tpu.conflict.api import TxInfo
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+
+    rng = np.random.default_rng(seed)
+    dev = DeviceConflictSet(capacity=cap, run_slots=run_slots)
+    version = 0
+    for _ in range(n_batches):
+        version += 1
+        txns = []
+        for _ in range(n_txns):
+            # 8-byte keys: the [k, k+\x00) end key must still encode
+            # (the TxInfo path, unlike bench's device_pack, uses encode_keys)
+            ks = [rng.bytes(8) for _ in range(3)]
+            txns.append(
+                TxInfo(
+                    max(version - 2, 0),
+                    [(k, k + b"\x00") for k in ks[:2]],
+                    [(ks[2], ks[2] + b"\x00")],
+                )
+            )
+        dev.resolve_batch(version, txns)
+    return dev, dev.kernel_stats()
+
+
+def phase_main() -> None:
+    import jax
+
+    n_batches = int(os.environ.get("PROFILE_BATCHES", "12"))
+    n_txns = int(os.environ.get("PROFILE_TXNS", "512"))
+    cap = int(os.environ.get("PROFILE_CAP", str(1 << 15)))
+    dev, snap = drive_phase_stream(n_batches, n_txns, cap)
+    print(
+        f"backend: {jax.default_backend()}  incremental: {dev._incremental}"
+        f"  probe: {dev._probe_impl}  cap: {cap}"
+    )
+    phase = snap["phase"]
+    total = sum(phase.values()) or 1.0
+    print(f"\n{n_batches} batches x {n_txns} txns "
+          f"(runs_appended={snap['runs_appended']} "
+          f"compactions={snap['compactions']} "
+          f"full_merges={snap['full_merges']}):")
+    for name in ("sort_ms", "scan_ms", "merge_ms", "compact_ms"):
+        ms = phase[name]
+        print(f"  {name:<12s} {ms:9.2f} ms  {100 * ms / total:5.1f}%")
+    print(f"  {'pack_ms':<12s} {snap['pack_ms']:9.2f} ms")
+    print(f"  resolve p50 {snap['resolve_ms_p50']:.2f} ms  "
+          f"p99 {snap['resolve_ms_p99']:.2f} ms")
 
 
 _RTT_MS = [0.0]  # measured host<->device round-trip floor, subtracted
@@ -222,4 +290,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--phase" in sys.argv:
+        phase_main()
+    else:
+        main()

@@ -34,3 +34,11 @@ except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
 from jax._src import xla_bridge as _xb  # noqa: E402
 
 _xb._backend_factories.pop("axon", None)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: device-dependent or long-running; excluded from tier-1 "
+        "(-m 'not slow')",
+    )

@@ -400,3 +400,189 @@ class TestRound5Advice:
 
         assert c2.run_until(c2.loop.spawn(try_commit()), 300) == "locked"
         c2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round-5 advisor findings (ADVICE.md r5)
+
+
+class TestVersionstampPreResolve:
+    """ADVICE r5 low (roles/proxy.py:425): a malformed versionstamp offset
+    must fail the transaction BEFORE the resolution phase.  The old code
+    flipped the verdict to CONFLICT in phase 4 — after the resolvers had
+    already merged the txn's write ranges as committed — leaving phantom
+    conflict state that spuriously aborted later readers of those keys."""
+
+    def test_offset_validator_matches_resolver(self):
+        from foundationdb_tpu.roles.types import (
+            Mutation,
+            MutationType,
+            resolve_versionstamp,
+            versionstamp_offset_ok,
+        )
+
+        cases = [
+            b"\x00" * 10 + (0).to_bytes(4, "little"),          # ok
+            b"k/" + b"\x00" * 10 + (2).to_bytes(4, "little"),  # ok
+            b"\x00" * 10 + (200).to_bytes(4, "little"),        # out of range
+            b"\x00" * 5 + (0).to_bytes(4, "little"),           # too short
+            b"\x01",                                           # < 4 bytes
+        ]
+        for raw in cases:
+            for mt, m in [
+                (MutationType.SET_VERSIONSTAMPED_KEY,
+                 Mutation(MutationType.SET_VERSIONSTAMPED_KEY, raw, b"v")),
+                (MutationType.SET_VERSIONSTAMPED_VALUE,
+                 Mutation(MutationType.SET_VERSIONSTAMPED_VALUE, b"k", raw)),
+            ]:
+                ok = versionstamp_offset_ok(m)
+                try:
+                    resolve_versionstamp(m, 7, 0)
+                    resolved = True
+                except ValueError:
+                    resolved = False
+                assert ok == resolved, (mt, raw)
+
+    def test_malformed_offset_leaves_conflict_set_clean(self):
+        """A hostile client's malformed offset (injected past the client
+        API's validation) fails its own txn pre-resolve; a reader of the
+        same key with a PRE-commit snapshot must then commit — phantom
+        committed ranges would abort it."""
+        import pytest
+
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+        from foundationdb_tpu.keys import key_after
+        from foundationdb_tpu.roles.types import NotCommitted
+
+        c = RecoverableCluster(seed=565)
+        db = c.database()
+
+        async def main():
+            # snapshot pinned BEFORE the malformed commit: phantom write
+            # ranges at the malformed txn's version would conflict with it
+            tr2 = db.create_transaction()
+            await tr2.get_read_version()
+
+            tr_bad = db.create_transaction()
+            tr_bad.set(b"dummy", b"x")
+            bad_key = b"vs/" + b"\x00" * 10 + (200).to_bytes(4, "little")
+            tr_bad._mutations.append(
+                Mutation(MutationType.SET_VERSIONSTAMPED_KEY, bad_key, b"p")
+            )
+            tr_bad._write_ranges.append((bad_key, key_after(bad_key)))
+            with pytest.raises(NotCommitted):
+                await tr_bad.commit()
+
+            # reads the exact keys the malformed txn would have poisoned
+            assert await tr2.get(bad_key) is None
+            assert await tr2.get(b"dummy") is None
+            tr2.set(b"clean", b"1")
+            await tr2.commit()  # phantom state would raise NotCommitted
+
+            tr3 = db.create_transaction()
+            return await tr3.get(b"clean"), await tr3.get(b"dummy")
+
+        clean, dummy = c.run_until(c.loop.spawn(main()), 300)
+        assert clean == b"1"
+        # pre-resolve failure is all-or-nothing: no mutation of the
+        # malformed txn was applied either
+        assert dummy is None
+        c.stop()
+
+
+class TestFailoverDrain:
+    """ADVICE r5 medium (client/dr.py:277): DR failover's drain target must
+    be version-consistent with the lock.  A commit already past the lock
+    gate when failover arms it used to commit at a version above `final`,
+    surviving on the primary only — the drained failover (pause_commits +
+    in-flight drain before reading `final`) makes the outcome atomic."""
+
+    def test_failover_covers_inflight_commit(self):
+        from foundationdb_tpu.client.dr import DRAgent
+        from foundationdb_tpu.control.recoverable import RecoverableCluster
+        from foundationdb_tpu.roles.proxy import CommitProxy
+        from foundationdb_tpu.roles.types import GetCommitVersionRequest
+
+        primary = RecoverableCluster(seed=566)
+        secondary = RecoverableCluster(seed=567, loop=primary.loop)
+        pri_db = primary.database()
+
+        async def main():
+            tr = pri_db.create_transaction()
+            tr.set(b"base", b"1")
+            await tr.commit()
+
+            agent = DRAgent(primary, secondary)
+            await agent.start()
+            for _ in range(100):
+                await primary.loop.delay(0.1)
+                gen = secondary.controller.generation
+                if gen is not None and all(p.locked for p in gen.proxies):
+                    break
+
+            # one-shot stall BETWEEN the lock gate and version assignment,
+            # keyed to the batch that actually carries the racer's mutation
+            # (other traffic — DR bookkeeping, the failover's own lock txn —
+            # must flow): the racing commit is in flight (past the gate, no
+            # version yet) exactly while failover arms the lock and samples
+            # `final`.
+            state = {"armed": True}
+            orig = CommitProxy._retry_reply
+            orig_inner = CommitProxy._commit_batch_inner
+
+            async def tagged_inner(self, batch):
+                if any(
+                    any(m.key == b"raced" for m in pc.request.mutations)
+                    for pc in batch
+                ):
+                    self._racer_inflight = True
+                try:
+                    return await orig_inner(self, batch)
+                finally:
+                    self._racer_inflight = False
+
+            async def stalled(self, ref, payload, deadline):
+                if (
+                    isinstance(payload, GetCommitVersionRequest)
+                    and getattr(self, "_racer_inflight", False)
+                    and state["armed"]
+                ):
+                    state["armed"] = False
+                    await self.loop.delay(1.0)
+                return await orig(self, ref, payload, deadline)
+
+            CommitProxy._commit_batch_inner = tagged_inner
+
+            CommitProxy._retry_reply = stalled
+            try:
+                async def racer():
+                    tr = pri_db.create_transaction()
+                    tr.set(b"raced", b"1")
+                    try:
+                        await tr.commit()
+                        return True
+                    except Exception:
+                        return False
+
+                task = primary.loop.spawn(racer())
+                await primary.loop.delay(0.2)  # let it pass the gate + stall
+                final = await agent.failover(timeout=240.0)
+                committed = await task
+            finally:
+                CommitProxy._retry_reply = orig
+                CommitProxy._commit_batch_inner = orig_inner
+
+            sec_db = secondary.database()
+            tr = sec_db.create_transaction()
+            return committed, await tr.get(b"raced"), await tr.get(b"base"), final
+
+        committed, raced, base, final = primary.run_until(
+            primary.loop.spawn(main()), 600
+        )
+        assert base == b"1"
+        # atomic outcome: a commit that succeeded on the primary is visible
+        # on the promoted secondary (it drained below `final`), and one that
+        # failed left no trace on either side
+        assert (raced == b"1") == committed
+        primary.stop()
+        secondary.stop()

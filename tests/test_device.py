@@ -114,7 +114,10 @@ def test_capacity_regrowth():
     """Overflowing the boundary array regrows and replays transparently."""
     rng = random.Random(7)
     oracle = OracleConflictSet()
-    dev = DeviceConflictSet(capacity=16)
+    # legacy (full-merge) path: regrowth-on-overflow is its mechanism;
+    # the incremental path absorbs the same batches as runs (see
+    # test_pallas.py for the compaction-regrow twin)
+    dev = DeviceConflictSet(capacity=16, incremental=False)
     version = 0
     for _ in range(4):
         version += 5
@@ -158,7 +161,8 @@ def test_shared_prefix_search_fallback():
 
     # the bucketed search is the impl with the depth fallback; the sort
     # search is exact at any depth and never needs one
-    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket",
+                            incremental=False)
     ref = OracleConflictSet()
 
     # 3000 distinct point writes, all sharing the 2-byte prefix ZZ: their
@@ -199,7 +203,8 @@ def test_pipelined_deferred_failure_replays_through_sync():
               TxInfo(5, [(b"ZZ0001", b"ZZ2999")], [])]),
     ]
 
-    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket",
+                            incremental=False)
     for v, txns in stream:
         packed = pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)
         dev.resolve_arrays(v, *packed[:-1], sync=False)
@@ -207,7 +212,8 @@ def test_pipelined_deferred_failure_replays_through_sync():
         dev.check_pipelined()
 
     # recovery: replay the stream sync on a fresh set; parity vs oracle
-    fresh = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
+    fresh = DeviceConflictSet(capacity=1 << 14, search_impl="bucket",
+                              incremental=False)
     ref = OracleConflictSet()
     for v, txns in stream:
         assert fresh.resolve_batch(v, txns) == ref.resolve_batch(v, txns)
@@ -222,7 +228,8 @@ def test_regrow_preserves_pending_pipelined_failure():
 
     from foundationdb_tpu.conflict.device import DeviceConflictSet, pack_batch
 
-    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket")
+    dev = DeviceConflictSet(capacity=1 << 14, search_impl="bucket",
+                            incremental=False)
 
     def packed(txns):
         return pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)[:-1]

@@ -1,0 +1,317 @@
+"""Pallas sort-scan conflict kernel: interpret-mode parity vs the oracle,
+and the incremental (run-append + deferred k-way merge) machinery.
+
+The Pallas kernel (conflict/pallas_kernel.py) is the device lowering of the
+committed-run probe; tier-1 pins its semantics on CPU via
+`pl.pallas_call(..., interpret=True)` — the same kernel body the TPU
+compiles, run by the Pallas interpreter — against the pure-Python oracle.
+The XLA fallback must agree bit-for-bit with both (the capability-probe
+chain of docs/KERNEL.md).  A `slow`-marked variant covers the compiled
+lowering on real TPU hardware.
+"""
+
+import random
+
+import pytest
+
+pytest.importorskip(
+    "jax.experimental.pallas", reason="installed jax lacks Pallas support"
+)
+
+from foundationdb_tpu.conflict import pallas_kernel
+from foundationdb_tpu.conflict.api import TxInfo, Verdict
+from foundationdb_tpu.conflict.device import DeviceConflictSet
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+
+
+def _rand_key(rng, alphabet=b"abcd", max_len=5):
+    return bytes(rng.choice(alphabet) for _ in range(rng.randrange(max_len + 1)))
+
+
+def _rand_range(rng):
+    if rng.random() < 0.5:  # point range [k, k+\0)
+        k = _rand_key(rng)
+        return k, k + b"\x00"
+    a, b = sorted((_rand_key(rng), _rand_key(rng)))
+    return a, b + b"\x00"
+
+
+def _rand_batch(rng, version, oldest, n):
+    txns = []
+    for _ in range(n):
+        lo = max(oldest - 3, 0)
+        snap = rng.randrange(lo, version)
+        txns.append(
+            TxInfo(
+                read_snapshot=snap,
+                read_ranges=[_rand_range(rng) for _ in range(rng.randrange(4))],
+                write_ranges=[_rand_range(rng) for _ in range(rng.randrange(3))],
+            )
+        )
+    return txns
+
+
+@pytest.mark.parametrize("lsm", [False, True], ids=["flat", "lsm"])
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_interpret_parity_sweep(seed, lsm):
+    """Randomized batches through the interpret-mode Pallas probe, flat and
+    LSM layouts, with mid-stream GC (version-window edges) and small run
+    slots so deferred compactions fire repeatedly."""
+    rng = random.Random(seed)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(
+        capacity=1 << 10, lsm=lsm, incremental=True,
+        run_slots=3, run_capacity=64, pallas="interpret",
+    )
+    assert dev._probe_impl == "interpret"
+    version = 0
+    for _ in range(20):
+        version += rng.randrange(1, 8)
+        txns = _rand_batch(rng, version, oracle.oldest_version, rng.randrange(1, 12))
+        want = oracle.resolve_batch(version, txns)
+        got = dev.resolve_batch(version, txns)
+        assert got == want, f"seed={seed} lsm={lsm} version={version}"
+        if rng.random() < 0.3:
+            floor = rng.randrange(version + 1)
+            oracle.remove_before(floor)
+            dev.remove_before(floor)
+    assert dev.stats.runs_appended == 20
+    assert dev.stats.full_merges == 0
+    assert dev.compactions >= 1, "run slots never filled — weak test setup"
+
+
+def test_version_window_edges_interpret():
+    """Exact window-edge semantics through the run probe: a conflict is
+    `run version > snapshot` (strict), runs GC'd below the floor go dead,
+    and snapshots below the floor are TOO_OLD."""
+    dev = DeviceConflictSet(
+        capacity=1 << 9, incremental=True, run_slots=4, run_capacity=32,
+        pallas="interpret",
+    )
+    r = lambda k: (k, k + b"\x00")
+    # write k at version 10: the run carries exactly version 10
+    assert dev.resolve_batch(
+        10, [TxInfo(0, [], [r(b"k")])]
+    ) == [Verdict.COMMITTED]
+    # snapshot 9 < 10 conflicts; snapshot 10 does not (strict >)
+    assert dev.resolve_batch(
+        11, [TxInfo(9, [r(b"k")], []), TxInfo(10, [r(b"k")], [])]
+    ) == [Verdict.CONFLICT, Verdict.COMMITTED]
+    # floor past the run's version: the run is dead, the write invisible —
+    # and snapshots below the floor are TOO_OLD before any range check
+    dev.remove_before(11)
+    assert dev.resolve_batch(
+        20, [TxInfo(5, [r(b"k")], []), TxInfo(11, [r(b"k")], [])]
+    ) == [Verdict.TOO_OLD, Verdict.COMMITTED]
+
+
+def test_probe_chain_agrees_xla_vs_interpret():
+    """The capability-probe chain must be semantics-free: the same stream
+    through the XLA fallback and the interpret-mode Pallas kernel produces
+    identical verdicts (bit-for-bit, docs/KERNEL.md contract)."""
+    streams = []
+    for impl_override in ("off", "interpret"):
+        rng = random.Random(99)
+        dev = DeviceConflictSet(
+            capacity=1 << 10, incremental=True, run_slots=3,
+            run_capacity=64, pallas=impl_override,
+        )
+        out = []
+        version = 0
+        for _ in range(15):
+            version += rng.randrange(1, 5)
+            txns = _rand_batch(rng, version, dev.oldest_version, rng.randrange(1, 10))
+            out.append(dev.resolve_batch(version, txns))
+        streams.append(out)
+    assert streams[0] == streams[1]
+
+
+def test_pallas_mode_probe():
+    assert pallas_kernel.pallas_mode("off") is None
+    assert pallas_kernel.pallas_mode("interpret") == "interpret"
+    with pytest.raises(ValueError, match="unknown"):
+        pallas_kernel.pallas_mode("bogus")
+    # auto on CPU: never interpret implicitly (orders of magnitude slower)
+    assert pallas_kernel.pallas_mode("auto") in (None, "tpu")
+
+
+def test_incremental_compaction_regrows_capacity():
+    """Twin of test_device.test_capacity_regrowth for the incremental path:
+    the deferred fold (not the per-batch merge) is what outgrows main, and
+    it must regrow transparently with oracle-exact verdicts throughout."""
+    rng = random.Random(7)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(
+        capacity=16, incremental=True, run_slots=2, run_capacity=64,
+    )
+    version = 0
+    for _ in range(6):
+        version += 5
+        txns = [
+            TxInfo(
+                read_snapshot=version - 5,
+                read_ranges=[_rand_range(rng)],
+                write_ranges=[(k := _rand_key(rng, b"abcdefgh", 6), k + b"\x00")],
+            )
+            for _ in range(24)
+        ]
+        assert dev.resolve_batch(version, txns) == oracle.resolve_batch(version, txns)
+    assert dev.compactions >= 2
+    assert dev.capacity > 16
+
+
+def test_pipelined_incremental_stream_parity():
+    """sync=False incremental stream: run bookkeeping is host-deterministic
+    (appends cannot overflow), so drain only checks search convergence; the
+    verdicts must still match a sync oracle run batch-for-batch."""
+    import numpy as np
+
+    from foundationdb_tpu.conflict.device import pack_batch
+
+    rng = random.Random(21)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(
+        capacity=1 << 10, incremental=True, run_slots=3, run_capacity=64,
+    )
+    version, pending = 0, []
+    for _ in range(12):
+        version += rng.randrange(1, 4)
+        txns = _rand_batch(rng, version, oracle.oldest_version, rng.randrange(1, 8))
+        want = oracle.resolve_batch(version, txns)
+        packed = pack_batch(txns, dev.oldest_version, dev._offset, dev._max_key_bytes)
+        got = dev.resolve_arrays(version, *packed[:-1], sync=False)
+        pending.append((len(txns), got, want))
+    dev.check_pipelined()
+    for n, got, want in pending:
+        assert [Verdict(int(c)) for c in np.asarray(got)[:n]] == want
+
+
+def test_phase_counters_populated():
+    """Phase timing mode splits the fused kernel into per-phase dispatches;
+    all four sort/scan/merge/compact counters must land in kernel_stats."""
+    rng = random.Random(5)
+    dev = DeviceConflictSet(
+        capacity=1 << 9, incremental=True, run_slots=2, run_capacity=64,
+    )
+    dev._phase_timing = True
+    version = 0
+    for _ in range(5):
+        version += 2
+        dev.resolve_batch(version, _rand_batch(rng, version, 0, 6))
+    phase = dev.kernel_stats()["phase"]
+    assert phase["sort_ms"] > 0
+    assert phase["scan_ms"] > 0
+    assert phase["merge_ms"] > 0
+    assert phase["compact_ms"] > 0  # run_slots=2 forces a deferred fold
+
+
+def test_sharded_incremental_parity():
+    """The sharded backend reuses the incremental kernel per shard (clip →
+    probe → append → pmin); parity vs the per-partition multi-oracle."""
+    from foundationdb_tpu.parallel.sharded import (
+        ShardedDeviceConflictSet,
+        make_resolver_mesh,
+    )
+    from tests.test_sharded import MultiOracle
+
+    mesh = make_resolver_mesh(2)
+    splits = [b"c"]
+    rng = random.Random(13)
+    ref = MultiOracle(splits)
+    cs = ShardedDeviceConflictSet(
+        mesh, splits, capacity=1 << 9, incremental=True,
+        run_slots=2, run_capacity=64,
+    )
+    version = 0
+    for _ in range(12):
+        version += rng.randrange(1, 5)
+        txns = _rand_batch(rng, version, cs.oldest_version, rng.randrange(1, 8))
+        assert cs.resolve_batch(version, txns) == ref.resolve_batch(version, txns)
+        if rng.random() < 0.25:
+            floor = rng.randrange(version + 1)
+            ref.remove_before(floor)
+            cs.remove_before(floor)
+    assert cs.compactions >= 1
+
+
+def test_sharded_interpret_probe():
+    """The Pallas kernel traces under shard_map too (interpret mode on CPU):
+    one small stream, parity vs the multi-oracle."""
+    from foundationdb_tpu.parallel.sharded import (
+        ShardedDeviceConflictSet,
+        make_resolver_mesh,
+    )
+    from tests.test_sharded import MultiOracle
+
+    mesh = make_resolver_mesh(2)
+    splits = [b"c"]
+    rng = random.Random(3)
+    ref = MultiOracle(splits)
+    cs = ShardedDeviceConflictSet(
+        mesh, splits, capacity=1 << 8, incremental=True,
+        run_slots=2, run_capacity=32, pallas="interpret",
+    )
+    version = 0
+    for _ in range(4):
+        version += 2
+        txns = _rand_batch(rng, version, 0, 4)
+        assert cs.resolve_batch(version, txns) == ref.resolve_batch(version, txns)
+
+
+@pytest.mark.slow
+def test_pallas_compiled_tpu_parity():
+    """Compiled-Pallas lowering on real TPU hardware (the production path
+    of the capability probe).  Skips unless the default backend is a TPU —
+    the CPU twin of this sweep is test_pallas_interpret_parity_sweep."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU backend available")
+    rng = random.Random(1)
+    oracle = OracleConflictSet()
+    dev = DeviceConflictSet(
+        capacity=1 << 12, incremental=True, run_slots=4,
+        run_capacity=256, pallas="tpu",
+    )
+    assert dev._probe_impl == "tpu"
+    version = 0
+    for _ in range(30):
+        version += rng.randrange(1, 8)
+        txns = _rand_batch(rng, version, oracle.oldest_version, rng.randrange(1, 16))
+        assert dev.resolve_batch(version, txns) == oracle.resolve_batch(version, txns)
+        if rng.random() < 0.3:
+            floor = rng.randrange(version + 1)
+            oracle.remove_before(floor)
+            dev.remove_before(floor)
+
+
+def test_sharded_incremental_fold_regrow():
+    """The sharded deferred fold must regrow a partition's main level when
+    the folded union outgrows it (the incremental twin of
+    test_sharded.test_sharded_capacity_regrow), with multi-oracle parity."""
+    from foundationdb_tpu.parallel.sharded import (
+        ShardedDeviceConflictSet,
+        make_resolver_mesh,
+    )
+    from tests.test_sharded import MultiOracle
+
+    mesh = make_resolver_mesh(2)
+    splits = [b"\x80"]
+    ref = MultiOracle(splits)
+    cs = ShardedDeviceConflictSet(
+        mesh, splits, capacity=16, incremental=True,
+        run_slots=2, run_capacity=64,
+    )
+    version = 0
+    for b in range(6):
+        version += 2
+        txns = [
+            TxInfo(max(version - 2, 0), [], [(bytes([0, b, i]), bytes([0, b, i, 0]))])
+            for i in range(20)
+        ]
+        assert cs.resolve_batch(version, txns) == ref.resolve_batch(version, txns)
+    assert cs.compactions >= 1
+    assert cs.regrows >= 1 and cs.capacity > 16
+    probe = [TxInfo(1, [(bytes([0, 0, 5]), bytes([0, 0, 6]))], [])]
+    version += 1
+    assert cs.resolve_batch(version, probe) == ref.resolve_batch(version, probe)
