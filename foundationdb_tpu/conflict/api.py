@@ -64,7 +64,11 @@ class KernelStats:
     only and never feed back into simulation behavior, so determinism is
     unaffected.  `pack_s` is TxInfo→tensor/ABI marshalling, `resolve_s` the
     backend check itself, `merge_s` state maintenance outside the check
-    (device GC/compaction kernels; CPU removeBefore).
+    (device GC/compaction kernels; CPU removeBefore).  `pack_s` further
+    splits into `encode_s` (key flatten + lane encode), `pad_s` (bucketing
+    and staging-arena fill) and `h2d_s` (explicit host→device staging, only
+    where a caller stages with device_put — the input-pipeline counters of
+    docs/KERNEL.md "Input pipeline").
 
     The per-phase splits (`sort_s`/`scan_s`/`append_s`/`compact_s`) mirror
     the device kernel's sort-scan decomposition (docs/KERNEL.md): sort =
@@ -82,6 +86,9 @@ class KernelStats:
     txns: int = 0
     aborted: int = 0            # CONFLICT verdicts
     pack_s: float = 0.0
+    encode_s: float = 0.0       # pack phase: key flatten + lane encode
+    pad_s: float = 0.0          # pack phase: bucket/pad/arena fill
+    h2d_s: float = 0.0          # pack phase: explicit host->device staging
     resolve_s: float = 0.0
     merge_s: float = 0.0
     sort_s: float = 0.0         # phase: state rank / sort-merge
@@ -133,6 +140,9 @@ class KernelStats:
             "runs_appended": self.runs_appended,
             "full_merges": self.full_merges,
             "pack_ms": self.pack_s * 1e3,
+            "encode_ms": self.encode_s * 1e3,
+            "pad_ms": self.pad_s * 1e3,
+            "h2d_ms": self.h2d_s * 1e3,
             "resolve_ms": self.resolve_s * 1e3,
             "merge_ms": self.merge_s * 1e3,
             "phase": {
@@ -146,6 +156,30 @@ class KernelStats:
         }
 
 
+class ResolveHandle:
+    """Handle for a (possibly still in-flight) batch resolve.  `wait()`
+    returns the per-txn verdicts, blocking until they are trustworthy —
+    for device backends that means fetching the device verdict array AND
+    draining the deferred validity checks (conflict/pipeline.py)."""
+
+    def wait(self) -> list[Verdict]:
+        raise NotImplementedError
+
+
+class CompletedResolve(ResolveHandle):
+    """Already-resolved handle: the synchronous backends' resolve_deferred
+    result, and the pipelined backends' fallback when a batch cannot be
+    deferred (empty batch, capacity fall-through)."""
+
+    __slots__ = ("_verdicts",)
+
+    def __init__(self, verdicts: list[Verdict]) -> None:
+        self._verdicts = verdicts
+
+    def wait(self) -> list[Verdict]:
+        return self._verdicts
+
+
 class ConflictSet:
     """Abstract conflict set; implementations: oracle (conflict/oracle.py),
     native C++ (conflict/native.py), TPU (conflict/tpu.py)."""
@@ -154,6 +188,16 @@ class ConflictSet:
         """Check all txns against history + each other; insert committed
         txns' writes at commit_version; return per-txn verdicts."""
         raise NotImplementedError
+
+    def resolve_deferred(self, commit_version: int, txns: Sequence[TxInfo]) -> ResolveHandle:
+        """Split-phase resolve: dispatch the batch and return a handle whose
+        `wait()` yields the verdicts.  The state transition happens in
+        dispatch order regardless of when handles are waited, so a caller
+        may dispatch batch N+1 before draining batch N's verdicts (the
+        resolver role's input pipeline, FDBTPU_PIPELINE).  Backends without
+        a device stream resolve synchronously here — the default makes the
+        split-phase caller exactly equivalent to the sequential one."""
+        return CompletedResolve(self.resolve_batch(commit_version, txns))
 
     def remove_before(self, version: int) -> None:
         """GC write ranges older than `version`; txns with read_snapshot <

@@ -60,6 +60,8 @@ from __future__ import annotations
 
 import functools
 import time
+from itertools import chain
+from operator import attrgetter
 from typing import Sequence
 
 import jax
@@ -71,6 +73,7 @@ from ..ops.rmq import I32_MAX, _levels, build_sparse_table, query_sparse_table
 from ..ops.search import lex_less
 from . import pallas_kernel
 from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
+from .pipeline import PipelinedConflictMixin
 from ..runtime.coverage import testcov
 
 _SENT_WORD = np.uint32(0xFFFFFFFF)
@@ -1123,17 +1126,28 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
-def pack_batch(txns, oldest: int, offset, max_key_bytes: int):
-    """Marshal a TxInfo batch into padded device tensors.
+def pack_batch_loop(txns, oldest: int, offset, max_key_bytes: int,
+                    stats=None):
+    """Reference (per-transaction, per-range loop) TxInfo marshaller.
 
-    Shared by the single-partition and mesh-sharded conflict sets so their
-    TxInfo→tensor encodings cannot drift (verdict parity depends on it).
-    `offset` maps an absolute version to the state's int32 offset.
-    Returns (rbv, rev, rtv, wbv, wev, wtv, snap, active, bucketed_B).
+    Kept as the parity referee for the vectorized pack_batch below, and as
+    its fallback for batches containing over-length keys, whose
+    drop-vs-raise semantics need byte-level compares the lane encoding
+    cannot represent.  Same contract as pack_batch; `stats` records the
+    same encode_s (lane encoding) / pad_s (everything else: the Python
+    loops plus padded-array building) split so the two paths' marshalling
+    costs are directly comparable.
     """
+    t_start = time.perf_counter()
+    enc_spent = [0.0]
     B = len(txns)
     W = keymod.num_words(max_key_bytes)
-    enc = functools.partial(keymod.encode_keys, max_key_bytes=max_key_bytes)
+
+    def enc(keys):
+        t0 = time.perf_counter()
+        out = keymod.encode_keys(keys, max_key_bytes=max_key_bytes)
+        enc_spent[0] += time.perf_counter() - t0
+        return out
     active = np.zeros(B, dtype=bool)
     snap = np.zeros(B, dtype=np.int32)
     rb_k: list[bytes] = []
@@ -1176,16 +1190,193 @@ def pack_batch(txns, oldest: int, offset, max_key_bytes: int):
     snap_p[:B] = snap
     active_p = np.zeros(Bp, dtype=bool)
     active_p[:B] = active
+    if stats is not None:
+        stats.encode_s += enc_spent[0]
+        stats.pad_s += time.perf_counter() - t_start - enc_spent[0]
     return rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp
 
 
-class DeviceConflictSet(ConflictSet):
+def _np_rows_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Rowwise lexicographic a < b over uint32[N, W] lane rows, host-side.
+    Faithful to byte-string order for keys within max_key_bytes (keys.py
+    module docstring: the length lane breaks zero-padding ties)."""
+    neq = a != b
+    any_neq = neq.any(axis=1)
+    first = neq.argmax(axis=1)
+    rows = np.arange(a.shape[0])
+    return any_neq & (a[rows, first] < b[rows, first])
+
+
+def pack_batch(txns, oldest: int, offset, max_key_bytes: int, *,
+               arena=None, stats=None, offset_array=None):
+    """Marshal a TxInfo batch into padded device tensors — the BULK path.
+
+    Shared by the single-partition and mesh-sharded conflict sets so their
+    TxInfo→tensor encodings cannot drift (verdict parity depends on it).
+    `offset` maps an absolute version to the state's int32 offset.
+    Returns (rbv, rev, rtv, wbv, wev, wtv, snap, active, bucketed_B).
+
+    Bit-identical tensors to pack_batch_loop, produced without per-range
+    Python loops: ONE pass flattens every conflict-range endpoint of the
+    batch into a single byte stream, ONE keys.encode_concat call encodes
+    them all, the b < e liveness filter runs as a vectorized lane compare
+    on the encoded rows, and the padded outputs fill preallocated
+    staging-arena slots (conflict/pipeline.py PackArena) instead of fresh
+    np.full allocations per batch.  Optional hooks:
+
+      arena         PackArena: rotating per-bucket-shape staging buffers
+      stats         KernelStats: lands the encode_s / pad_s phase split
+      offset_array  vectorized `offset` twin (np array -> np array); when
+                    absent, `offset` is called per active transaction in
+                    order, exactly like the loop path
+
+    Batches containing a key longer than max_key_bytes delegate to
+    pack_batch_loop (encoded-lane compares cannot decide their b < e
+    liveness, so the raise-vs-drop semantics live there).
+    """
+    B = len(txns)
+    if B == 0:
+        return pack_batch_loop(txns, oldest, offset, max_key_bytes, stats=stats)
+    t0 = time.perf_counter()
+    W = keymod.num_words(max_key_bytes)
+    snaps_raw = np.fromiter(
+        (t.read_snapshot for t in txns), dtype=np.int64, count=B
+    )
+    active = snaps_raw >= oldest
+    if active.all():
+        act_txns = txns if isinstance(txns, list) else list(txns)
+        act_ids = np.arange(B, dtype=np.int32)
+    else:  # TOO_OLD txns contribute no ranges (SkipList.cpp:985)
+        alist = active.tolist()
+        act_txns = [t for t, a in zip(txns, alist) if a]
+        act_ids = np.flatnonzero(active).astype(np.int32)
+    nA = len(act_txns)
+    r_counts = np.fromiter(
+        map(len, map(attrgetter("read_ranges"), act_txns)),
+        dtype=np.int64, count=nA,
+    )
+    w_counts = np.fromiter(
+        map(len, map(attrgetter("write_ranges"), act_txns)),
+        dtype=np.int64, count=nA,
+    )
+    # flatten [(b0,e0), (b1,e1), ...] across txns into one key stream
+    r_keys = list(
+        chain.from_iterable(chain.from_iterable(t.read_ranges for t in act_txns))
+    )
+    w_keys = list(
+        chain.from_iterable(chain.from_iterable(t.write_ranges for t in act_txns))
+    )
+    all_keys = r_keys + w_keys
+    n_all = len(all_keys)
+    lens = np.fromiter(map(len, all_keys), dtype=np.int64, count=n_all)
+    if n_all and int(lens.max()) > max_key_bytes:
+        return pack_batch_loop(txns, oldest, offset, max_key_bytes, stats=stats)
+    enc = keymod.encode_concat(b"".join(all_keys), lens, max_key_bytes)
+    t1 = time.perf_counter()
+
+    nR, nW = len(r_keys) // 2, len(w_keys) // 2
+    pairs = enc.reshape(nR + nW, 2, W)
+    renc, wenc = pairs[:nR], pairs[nR:]
+    r_tx_all = np.repeat(act_ids, r_counts)
+    w_tx_all = np.repeat(act_ids, w_counts)
+    # ONE vectorized b < e liveness compare over every pair (read + write)
+    live = _np_rows_less(pairs[:, 0], pairs[:, 1]) if (nR + nW) else (
+        np.zeros(0, dtype=bool)
+    )
+    all_live = bool(live.all())
+    if all_live:
+        r_idx = w_idx = None
+        n_r, n_w = nR, nW
+    else:
+        r_idx = np.flatnonzero(live[:nR])
+        w_idx = np.flatnonzero(live[nR:])
+        n_r, n_w = len(r_idx), len(w_idx)
+    Bp, R, Wn = _bucket(B), _bucket(n_r), _bucket(n_w)
+
+    # snapshot offsets, in txn order (the loop path's offset() call order)
+    if offset_array is not None:
+        snap_vals = offset_array(snaps_raw[active])
+    else:
+        snap_vals = np.fromiter(
+            (offset(int(s)) for s in snaps_raw[active]), dtype=np.int64,
+            count=nA,
+        )
+
+    def fill_rows(kind: str, n_rows: int, enc3, idx, tx_all, all_live: bool):
+        n = enc3.shape[0] if all_live else len(idx)
+        if arena is not None:
+            slot = arena.rows(kind, n_rows, W, _SENT_WORD)
+            hi = slot.live
+            if hi > n:  # re-sentinel only the previously-live pad region
+                slot.b[n:hi] = _SENT_WORD
+                slot.e[n:hi] = _SENT_WORD
+                slot.t[n:hi] = -1
+            slot.live = n
+            out_b, out_e, out_t = slot.b, slot.e, slot.t
+        else:
+            out_b = np.full((n_rows, W), _SENT_WORD, dtype=np.uint32)
+            out_e = np.full((n_rows, W), _SENT_WORD, dtype=np.uint32)
+            out_t = np.full(n_rows, -1, dtype=np.int32)
+        if n:
+            if all_live:  # common case: contiguous copy, no gather
+                out_b[:n] = enc3[:, 0]
+                out_e[:n] = enc3[:, 1]
+                out_t[:n] = tx_all
+            else:
+                out_b[:n] = enc3[idx, 0]
+                out_e[:n] = enc3[idx, 1]
+                out_t[:n] = tx_all[idx]
+        return out_b, out_e, out_t
+
+    rbv, rev, rtv = fill_rows("r", R, renc, r_idx, r_tx_all, all_live)
+    wbv, wev, wtv = fill_rows("w", Wn, wenc, w_idx, w_tx_all, all_live)
+    if arena is not None:
+        ts = arena.txns(Bp)
+        hi = ts.live
+        if hi > B:
+            ts.snap[B:hi] = 0
+            ts.active[B:hi] = False
+        ts.live = B
+        snap_p, active_p = ts.snap, ts.active
+    else:
+        snap_p = np.zeros(Bp, dtype=np.int32)
+        active_p = np.zeros(Bp, dtype=bool)
+    if nA == B:
+        snap_p[:B] = snap_vals
+    else:
+        snap_p[:B] = 0
+        snap_p[act_ids] = snap_vals
+    active_p[:B] = active
+    if stats is not None:
+        t2 = time.perf_counter()
+        stats.encode_s += t1 - t0
+        stats.pad_s += t2 - t1
+    return rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp
+
+
+class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
     """ConflictSet backed by the JAX kernel above.
 
     Runs identically on the TPU backend (production) and the CPU/XLA backend
     (deterministic simulation) — the substitutability that mirrors the
     reference's Net2/Sim2 seam, applied to the device.
+
+    resolve_deferred (conflict/pipeline.py) adds the split-phase input
+    pipeline: dispatch batch N+1 before draining batch N's verdicts, with
+    snapshot/replay recovery for deferred-validity failures.
     """
+
+    # everything a dispatch, GC, regrow or compaction can move — the
+    # recovery snapshot for the pipelined window (jax arrays are immutable;
+    # host values are rebound, never mutated in place, by the resolve paths)
+    _PIPELINE_SNAPSHOT_ATTRS = (
+        "_ks", "_vs", "_bidx", "_count", "_count_ub", "_dev_count",
+        "_dev_ok", "_pipelined_since_check", "_last_commit", "_base",
+        "_oldest", "_cap", "_tab", "_rec_ks", "_rec_vs", "_rec_bidx",
+        "_rec_dev_count", "_rec_count_ub", "_rec_cap",
+        "_runs_b", "_runs_e", "_runs_ver", "_n_runs", "_run_rows_ub",
+        "_run_cap",
+    )
 
     def __init__(
         self,
@@ -1235,6 +1426,7 @@ class DeviceConflictSet(ConflictSet):
         # jit cache has seen — the bucket-induced recompiles ISSUE cites
         self.stats = KernelStats(backend="device")
         self._compiled_shapes: set[tuple] = set()
+        self._pipeline_init()  # staging arenas + deferred-resolve window
         self._init_state(capacity)
 
     def _init_state(self, capacity: int, ks=None, vs=None, count: int = 1) -> None:
@@ -1376,7 +1568,19 @@ class DeviceConflictSet(ConflictSet):
             )
         return max(off, 0)
 
+    def _offset_array(self, versions: np.ndarray) -> np.ndarray:
+        """Vectorized _offset twin for the bulk packer (one np pass per
+        batch instead of one Python call per transaction)."""
+        off = np.asarray(versions, dtype=np.int64) - self._base
+        if off.size and int(off.max()) >= 2**31 - 2**24:
+            raise OverflowError(
+                "version offset overflow: call remove_before to advance the "
+                "MVCC window (reference GCs every batch, SkipList.cpp:1199)"
+            )
+        return np.maximum(off, 0)
+
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
+        self._drain_all()  # settle any deferred window before sync work
         validate_batch(commit_version, txns, self._oldest)
         if commit_version <= self._last_commit:
             raise ValueError(
@@ -1389,7 +1593,9 @@ class DeviceConflictSet(ConflictSet):
 
         t_pack = time.perf_counter()
         rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
-            txns, self._oldest, self._offset, self._max_key_bytes
+            txns, self._oldest, self._offset, self._max_key_bytes,
+            arena=self._arena, stats=self.stats,
+            offset_array=self._offset_array,
         )
         self.stats.pack_s += time.perf_counter() - t_pack
         codes = self.resolve_arrays(
@@ -1419,6 +1625,10 @@ class DeviceConflictSet(ConflictSet):
         latency.  If a deferred check fails, check_pipelined raises and the
         caller must replay through the sync path (kernel is pure, so the
         host-side TxInfo stream is the source of truth)."""
+        if sync and self._inflight:
+            # mixed use: a deferred window is open — settle it first so the
+            # sync result (and any regrow/fallback replay) sees final state
+            self._drain_all()
         if commit_version <= self._last_commit:
             raise ValueError(
                 f"commit_version {commit_version} not after last batch {self._last_commit}"
@@ -1861,7 +2071,16 @@ class DeviceConflictSet(ConflictSet):
         off = version - self._base
         if off > 0:
             t0 = time.perf_counter()
-            if self._lsm:
+            if self._inflight:
+                # a deferred window is open: the recovery snapshot may alias
+                # these buffers, so clamp WITHOUT donation (eager ops build
+                # fresh arrays; GC is rare relative to resolves)
+                o = jnp.int32(off)
+                self._vs = jnp.maximum(self._vs - o, 0)
+                if self._lsm:
+                    self._tab = jnp.maximum(self._tab - o, 0)
+                    self._rec_vs = jnp.maximum(self._rec_vs - o, 0)
+            elif self._lsm:
                 self._vs, self._tab, self._rec_vs = _gc_lsm_kernel(
                     self._vs, self._tab, self._rec_vs, np.int32(off)
                 )
@@ -1877,3 +2096,4 @@ class DeviceConflictSet(ConflictSet):
             self._base = version
             self.stats.gc_calls += 1
             self.stats.merge_s += time.perf_counter() - t0
+            self._note_pipeline_gc(version)

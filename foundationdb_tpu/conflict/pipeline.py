@@ -1,0 +1,382 @@
+"""Host→device input pipeline for the conflict kernel.
+
+The reference resolver hides its host-side costs with 16-way
+software-pipelined skip-list cursors (fdbserver/SkipList.cpp:524,773); the
+TPU-native analog is to overlap the HOST phase of batch N+1 — TxInfo
+flattening, lane encoding, bucketing/padding, host→device staging — with the
+DEVICE execution of batch N.  Three pieces (docs/KERNEL.md "Input
+pipeline"):
+
+  PackArena          preallocated per-bucket-shape staging buffers, rotated
+                     double-buffered so pack_batch stops allocating (and
+                     sentinel-filling) fresh padded arrays every batch.
+  PipelinedPacker    a background thread that packs (and optionally stages
+                     onto the device) batch N+1 while the caller's thread
+                     drives batch N — the feeder for bench.py's
+                     resolver-e2e stream.
+  PipelinedConflictMixin
+                     resolve_deferred() for the device-backed conflict
+                     sets: dispatch sync=False, hand back a ResolveHandle,
+                     and self-heal a deferred-validity failure by restoring
+                     a pre-stream snapshot (jax arrays are immutable, so a
+                     snapshot is a tuple of references) and replaying the
+                     recorded batch/GC sequence through the sync path.
+
+Determinism: none of this runs under deterministic simulation unless a
+caller opts in (FDBTPU_PIPELINE, off by default — SimNetwork clusters keep
+the synchronous resolve path), and even opted-in the verdict stream is
+bit-identical to the synchronous path: packing is pure, dispatch order is
+version order, and recovery replays the exact recorded inputs.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .api import CompletedResolve, ResolveHandle, TxInfo, Verdict, validate_batch
+from ..runtime.coverage import testcov
+
+
+def pipeline_enabled(default: bool = False) -> bool:
+    """FDBTPU_PIPELINE knob: opt-in for the split-phase resolver pipeline.
+    Off by default (deterministic simulation and tier-1 CPU runs keep the
+    synchronous path); malformed values fail loudly at construction (the
+    knob-parsing convention)."""
+    v = os.environ.get("FDBTPU_PIPELINE")
+    if v is None:
+        return default
+    if v not in ("0", "1"):
+        raise ValueError(f"FDBTPU_PIPELINE must be 0 or 1, got {v!r}")
+    return v == "1"
+
+
+class _RowSlot:
+    __slots__ = ("b", "e", "t", "live")
+
+    def __init__(self, n: int, W: int, sent_word: int) -> None:
+        self.b = np.full((n, W), sent_word, dtype=np.uint32)
+        self.e = np.full((n, W), sent_word, dtype=np.uint32)
+        self.t = np.full(n, -1, dtype=np.int32)
+        self.live = 0
+
+
+class _TxnSlot:
+    __slots__ = ("snap", "active", "live")
+
+    def __init__(self, n: int) -> None:
+        self.snap = np.zeros(n, dtype=np.int32)
+        self.active = np.zeros(n, dtype=bool)
+        self.live = 0
+
+
+class PackArena:
+    """Preallocated per-bucket-shape staging buffers for pack_batch.
+
+    Every distinct (bucketed rows, key width) shape owns `depth` rotating
+    slot copies: slot i serves batch N, slot (i+1) % depth serves batch N+1,
+    so a batch whose arrays may still be read by an in-flight dispatch is
+    never overwritten by the next pack.  Callers must bound their in-flight
+    window to depth-1 batches (PipelinedConflictMixin enforces this;
+    PipelinedPacker stages device copies before reusing a slot).  Only the
+    previously-live pad region is re-sentinelled on reuse — the arena's
+    whole point is that steady-state packing writes O(live rows), not
+    O(bucket capacity)."""
+
+    def __init__(self, depth: int = 2) -> None:
+        if depth < 2:
+            raise ValueError("PackArena depth must be >= 2 (double buffering)")
+        self.depth = depth
+        self._rows: dict[tuple[str, int, int], list[_RowSlot]] = {}
+        self._txns: dict[int, list[_TxnSlot]] = {}
+        self._turn: dict[tuple, int] = {}
+
+    def _pick(self, pool: dict, key, make):
+        slots = pool.get(key)
+        if slots is None:
+            slots = pool[key] = [make() for _ in range(self.depth)]
+        i = self._turn.get(key, 0)
+        self._turn[key] = (i + 1) % self.depth
+        return slots[i]
+
+    def rows(self, kind: str, n: int, W: int, sent_word: int) -> _RowSlot:
+        """A (begin, end, txn-id) row slot for `n` bucketed rows; rows past
+        the previous occupant's live count are already sentinel/-1.
+
+        `kind` keeps the read and write pools distinct: each pool must
+        rotate exactly ONCE per batch, or a same-shaped read and write
+        class would share slots and reuse one while the previous batch's
+        kernel (JAX zero-copies aligned numpy inputs on CPU) still reads
+        it — a measured, alignment-dependent corruption."""
+        s = self._pick(
+            self._rows, (kind, n, W), lambda: _RowSlot(n, W, sent_word)
+        )
+        return s
+
+    def txns(self, n: int) -> _TxnSlot:
+        return self._pick(self._txns, n, lambda: _TxnSlot(n))
+
+
+class DeferredResolve(ResolveHandle):
+    """In-flight pipelined resolve: the device verdict array plus the
+    stream-folded validity flag as of this batch's dispatch.  `wait()`
+    drains through the owning conflict set so failures recover in order.
+
+    The handle keeps the original TxInfo list, NOT the packed arrays: the
+    staging-arena buffers rotate and may be rewritten by later packs, but
+    packing is pure, so a recovery replay re-packs from the TxInfo stream
+    and reproduces the dispatch-time tensors exactly."""
+
+    __slots__ = (
+        "owner", "version", "n_txn", "txns", "verdict_dev", "ok_dev",
+        "gc_after", "_result",
+    )
+
+    def __init__(self, owner, version: int, txns, verdict_dev, ok_dev) -> None:
+        self.owner = owner
+        self.version = version
+        self.n_txn = len(txns)
+        self.txns = txns
+        self.verdict_dev = verdict_dev
+        self.ok_dev = ok_dev
+        self.gc_after: list[int] = []   # remove_before calls after dispatch
+        self._result: list[Verdict] | None = None
+
+    def wait(self) -> list[Verdict]:
+        if self._result is None:
+            self.owner._drain_deferred(self)
+        assert self._result is not None
+        return self._result
+
+
+# after this many drained-but-replayable batches, validate the whole stream
+# once (one folded-flag fetch) and advance the recovery snapshot — bounds
+# both the replay window and the handles kept alive by a hot stream
+_REPLAY_WINDOW = 8
+
+
+class PipelinedConflictMixin:
+    """resolve_deferred() for the device-backed conflict sets.
+
+    Host classes provide: `_oldest`, `_offset`, `_offset_array`,
+    `_max_key_bytes`, `_dev_ok`, `stats`, `resolve_arrays(...)`,
+    `resolve_batch(...)`, `remove_before(...)`, `check_pipelined()`, and a
+    class-level `_PIPELINE_SNAPSHOT_ATTRS` naming every piece of state a
+    dispatch or GC can move.  jax arrays are immutable, so a snapshot is a
+    dict of references; host-side ints/np arrays are rebound (never mutated
+    in place) by the resolve paths, so references are safe there too.
+    """
+
+    _PIPELINE_SNAPSHOT_ATTRS: tuple[str, ...] = ()
+    _pipeline_depth = 2
+
+    def _pipeline_init(self) -> None:
+        self._inflight: list[DeferredResolve] = []
+        self._replayable: list[DeferredResolve] = []
+        self._pipe_snapshot: dict | None = None
+        # a slot is reused D packs later; with up to `depth` undrained
+        # dispatches outstanding, D = depth + 1 keeps every in-flight
+        # batch's arrays untouched until its kernel has completed
+        self._arena = PackArena(depth=self._pipeline_depth + 1)
+
+    def _take_snapshot(self) -> dict:
+        return {
+            a: getattr(self, a)
+            for a in self._PIPELINE_SNAPSHOT_ATTRS
+            if hasattr(self, a)
+        }
+
+    def resolve_deferred(self, commit_version: int, txns: Sequence[TxInfo]) -> ResolveHandle:
+        from .device import pack_batch  # runtime import: device imports this module
+
+        B = len(txns)
+        if B == 0:
+            return CompletedResolve(self.resolve_batch(commit_version, txns))
+        validate_batch(commit_version, txns, self._oldest)
+        # bound undrained dispatches so the arena never recycles a slot an
+        # in-flight kernel may still read (see _pipeline_init)
+        while len(self._inflight) >= self._pipeline_depth:
+            self._drain_deferred(self._inflight[0])
+        t0 = time.perf_counter()
+        packed = pack_batch(
+            txns, self._oldest, self._offset, self._max_key_bytes,
+            arena=self._arena, stats=self.stats,
+            offset_array=self._offset_array,
+        )[:8]
+        self.stats.pack_s += time.perf_counter() - t0
+        if not self._inflight:
+            self._pipe_snapshot = self._take_snapshot()
+        try:
+            verdict = self.resolve_arrays(commit_version, *packed, sync=False)
+        except RuntimeError:
+            # an internal near-capacity drain surfaced a deferred failure
+            self._recover_inflight()
+            return CompletedResolve(self.resolve_batch(commit_version, txns))
+        if isinstance(verdict, np.ndarray):
+            # the backend fell through to a synchronous resolve internally
+            # (capacity margin): verdicts are already trustworthy
+            if not self._inflight:
+                self._pipe_snapshot = None
+                self._replayable.clear()
+            return CompletedResolve(
+                [Verdict(int(c)) for c in verdict[:B]]
+            )
+        h = DeferredResolve(self, commit_version, list(txns), verdict, self._dev_ok)
+        self._inflight.append(h)
+        return h
+
+    def _drain_deferred(self, upto: DeferredResolve) -> None:
+        """Drain in dispatch order through `upto`; on a deferred-validity
+        failure, recover the whole window (snapshot restore + sync replay)."""
+        if upto._result is not None:
+            return
+        while self._inflight:
+            h = self._inflight[0]
+            v = np.asarray(h.verdict_dev)
+            if not bool(np.asarray(h.ok_dev)):
+                self._recover_inflight()
+                return
+            self._inflight.pop(0)
+            h._result = [Verdict(int(c)) for c in v[: h.n_txn]]
+            if self._inflight:
+                # later dispatches already ride on h's state: keep h
+                # replayable until the stream validates past it
+                self._replayable.append(h)
+                if len(self._replayable) >= _REPLAY_WINDOW and bool(
+                    np.asarray(self._dev_ok)
+                ):
+                    # the fetched fold just validated EVERY dispatched batch
+                    # (the fetch is a stream sync): drain the remainder of
+                    # the window in place and reset the recovery state —
+                    # a mid-window snapshot would be unusable, because the
+                    # still-inflight dispatches are already baked into it
+                    for hh in self._inflight:
+                        hh._result = [
+                            Verdict(int(c))
+                            for c in np.asarray(hh.verdict_dev)[: hh.n_txn]
+                        ]
+                    self._inflight.clear()
+            if not self._inflight:
+                self._replayable.clear()
+                self._pipe_snapshot = None
+                self.check_pipelined()  # refresh host counts; known-valid
+            if h is upto:
+                return
+
+    def _drain_all(self) -> None:
+        if self._inflight:
+            self._drain_deferred(self._inflight[-1])
+
+    def _recover_inflight(self) -> None:
+        """A deferred check failed somewhere in the in-flight window: restore
+        the pre-window snapshot and replay every recorded batch (and the GC
+        calls interleaved between them) through the sync path, which handles
+        full-depth search fallback and capacity regrow exactly.  Replays go
+        through resolve_batch from each handle's TxInfo stream — packing is
+        pure, so this reproduces the dispatch-time tensors even though the
+        arena buffers have rotated since.  Results for already-drained
+        (replayable) batches were valid — the replay reproduces them
+        bit-for-bit while rebuilding the state."""
+        pending = self._inflight
+        done = self._replayable
+        snap = self._pipe_snapshot
+        self._inflight, self._replayable, self._pipe_snapshot = [], [], None
+        assert snap is not None, "deferred failure with no recovery snapshot"
+        for a, val in snap.items():
+            setattr(self, a, val)
+        testcov("kernel.pipeline_recover")
+        for h in done + pending:
+            verdicts = self.resolve_batch(h.version, h.txns)
+            if h._result is None:
+                h._result = list(verdicts)
+            for gv in h.gc_after:
+                self.remove_before(gv)
+
+    def _note_pipeline_gc(self, version: int) -> None:
+        """remove_before while batches are in flight: record the call on the
+        newest dispatch so a recovery replays it at the right point."""
+        if self._inflight:
+            self._inflight[-1].gc_after.append(version)
+
+
+class PipelinedPacker:
+    """Background-thread double-buffered packer: packs (and optionally
+    stages onto the device) batch N+1 while the caller drives batch N.
+
+    `pack_fn(item)` runs on the worker thread and must return a tuple of
+    numpy arrays; `stage(packed)` (optional — e.g. jax.device_put) runs on
+    the worker thread too and its wall time lands in `stats.h2d_s`, giving
+    the h2d leg of the encode/pad/h2d pack split.  Results come back in
+    submission order.  `depth` bounds unconsumed packed batches, which is
+    what makes arena reuse safe: pack_fn's arena needs depth+1 rotating
+    slots at most, and the default PackArena depth of 2 matches the default
+    pipeline depth of 1 outstanding batch.
+
+    Never used under deterministic simulation (threads would break replay);
+    the sim resolver's split-phase path defers on the DEVICE stream instead
+    (PipelinedConflictMixin) and keeps packing on the caller's thread.
+    """
+
+    def __init__(
+        self,
+        pack_fn: Callable,
+        *,
+        depth: int = 2,
+        stage: Callable | None = None,
+        stats=None,
+    ) -> None:
+        self._pack_fn = pack_fn
+        self._stage = stage
+        self._stats = stats
+        self._in: queue.Queue = queue.Queue()
+        self._out: queue.Queue = queue.Queue()
+        self._space = threading.Semaphore(depth)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._in.get()
+            if item is _STOP:
+                self._out.put((False, RuntimeError("PipelinedPacker closed")))
+                return
+            try:
+                packed = self._pack_fn(item)
+                if self._stage is not None:
+                    t0 = time.perf_counter()
+                    packed = self._stage(packed)
+                    if self._stats is not None:
+                        self._stats.h2d_s += time.perf_counter() - t0
+                self._out.put((True, packed))
+            except BaseException as e:  # noqa: BLE001 — re-raised at get()
+                self._out.put((False, e))
+
+    def submit(self, item) -> None:
+        """Enqueue a batch for packing; blocks when `depth` packed batches
+        are waiting unconsumed (backpressure = the arena-reuse bound)."""
+        self._space.acquire()
+        self._in.put(item)
+
+    def get(self):
+        """Next packed batch, in submission order; re-raises pack errors."""
+        ok, payload = self._out.get()
+        self._space.release()
+        if not ok:
+            raise payload
+        return payload
+
+    def close(self) -> None:
+        self._in.put(_STOP)
+        self._thread.join(timeout=10)
+
+
+class _Stop:
+    pass
+
+
+_STOP = _Stop()

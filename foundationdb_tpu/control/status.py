@@ -73,7 +73,8 @@ def _kernel_rollup(resolvers) -> dict[str, Any]:
     for k in (
         "batches", "txns", "aborted", "rows_real", "rows_padded",
         "recompiles", "search_fallbacks", "compactions", "gc_calls",
-        "rows_reclaimed", "node_count", "pack_ms", "resolve_ms", "merge_ms",
+        "rows_reclaimed", "node_count", "pack_ms", "encode_ms", "pad_ms",
+        "h2d_ms", "resolve_ms", "merge_ms",
         "runs_appended", "full_merges",
     ):
         out[k] = sum(p.get(k, 0) for p in per)

@@ -49,33 +49,68 @@ def encode_keys(keys: list[bytes], max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -
     must handle arbitrary-length keys (FDB allows up to 10KB) catch this and
     route the batch to a host-side implementation (see conflict/tpu.py).
     """
-    kw = num_words(max_key_bytes) - 1  # validates max_key_bytes
     n = len(keys)
+    if n == 0:
+        return np.zeros((n, num_words(max_key_bytes)), dtype=np.uint32)
+    lens = np.fromiter(map(len, keys), count=n, dtype=np.int64)
+    return encode_concat(b"".join(keys), lens, max_key_bytes)
+
+
+def encode_concat(
+    flat: bytes | bytearray | memoryview | np.ndarray,
+    lens: np.ndarray,
+    max_key_bytes: int = DEFAULT_MAX_KEY_BYTES,
+) -> np.ndarray:
+    """Batch encoder over an already-concatenated byte stream: key i occupies
+    flat[sum(lens[:i]) : sum(lens[:i+1])].  One np.frombuffer view + one
+    vectorized gather — no per-key Python call, which is what the resolver's
+    bulk batch packer needs (it flattens every conflict-range endpoint of a
+    batch into one stream and encodes them all at once).  encode_keys is the
+    list-of-bytes convenience wrapper around this."""
+    kw = num_words(max_key_bytes) - 1  # validates max_key_bytes
+    lens = np.asarray(lens, dtype=np.int64)
+    n = lens.shape[0]
     out = np.zeros((n, kw + 1), dtype=np.uint32)
     if n == 0:
         return out
-    lens = np.fromiter((len(k) for k in keys), count=n, dtype=np.int64)
+    if isinstance(flat, np.ndarray):
+        flat = np.ascontiguousarray(flat, dtype=np.uint8)
+    else:
+        flat = np.frombuffer(flat, dtype=np.uint8)
     if lens.max() > max_key_bytes:
         i = int(np.argmax(lens))
-        raise KeyTooLongError(f"key of {len(keys[i])} bytes exceeds {max_key_bytes}")
+        raise KeyTooLongError(f"key of {int(lens[i])} bytes exceeds {max_key_bytes}")
     # Vectorized gather from the concatenated byte stream (hot path: the
     # resolver encodes every conflict-range endpoint of every batch).
-    flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
-    starts = np.zeros(n, dtype=np.int64)
-    np.cumsum(lens[:-1], out=starts[1:])
-    cols = np.arange(max_key_bytes, dtype=np.int64)
-    mask = cols[None, :] < lens[:, None]
-    idx = np.minimum(starts[:, None] + cols[None, :], max(len(flat) - 1, 0))
-    buf = np.where(mask, flat[idx] if len(flat) else np.uint8(0), np.uint8(0))
+    # Cache-conscious: int32 index math (len(flat) < 2**31 — a batch's key
+    # stream is megabytes), an in-bounds gather off a zero-padded stream
+    # with an in-place mask multiply instead of np.where temporaries, and
+    # the big-endian word packing done by a single dtype view + byteswap
+    # astype rather than four strided slice copies.
+    L = len(flat)
+    flatp = np.zeros(L + max_key_bytes, dtype=np.uint8)
+    flatp[:L] = flat
+    # gather indices reach L + max_key_bytes - 1 (the zero pad), so the
+    # int32 fast path needs headroom for the pad region too
+    idt = np.int32 if L + max_key_bytes < 2**31 else np.int64
+    starts = np.zeros(n, dtype=idt)
+    np.cumsum(lens[:-1], out=starts[1:], dtype=idt)
+    cols = np.arange(max_key_bytes, dtype=idt)
+    lens_t = lens.astype(idt)
     out[:, kw] = lens
-    # big-endian word packing: byte j contributes << (8 * (3 - j%4))
-    words = (
-        (buf[:, 0::4].astype(np.uint32) << 24)
-        | (buf[:, 1::4].astype(np.uint32) << 16)
-        | (buf[:, 2::4].astype(np.uint32) << 8)
-        | (buf[:, 3::4].astype(np.uint32))
-    )
-    out[:, :kw] = words
+    # chunked so the per-chunk index/byte temporaries stay cache-resident
+    # (one 50K-key gather measured ~2x slower than the same work in 8K
+    # slices); in bounds by construction: starts[i] <= L, so starts[i] +
+    # col < L + max_key_bytes — reads past a key's end land in the next
+    # key's bytes or the zero pad, and the mask multiply zeroes them.
+    step = 8192
+    for i in range(0, n, step):
+        j = min(i + step, n)
+        idx = starts[i:j, None] + cols[None, :]
+        buf = flatp[idx]
+        mask = cols[None, :] < lens_t[i:j, None]
+        np.multiply(buf, mask, out=buf, casting="unsafe")
+        out[i:j, :kw] = buf.view(">u4").astype(np.uint32)
     return out
 
 

@@ -30,6 +30,7 @@ only per-batch transfers are the batch tensors in and B verdicts out.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Sequence
 
 import jax
@@ -49,7 +50,8 @@ if not hasattr(jax, "shard_map"):  # pre-0.4.35 jax: not yet promoted out of
 
 from .. import keys as keymod
 from ..conflict import pallas_kernel
-from ..conflict.api import ConflictSet, TxInfo, Verdict, validate_batch
+from ..conflict.api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
+from ..conflict.pipeline import PipelinedConflictMixin
 from ..conflict.device import (
     _SENT_WORD,
     FAST_SEARCH_ITERS,
@@ -357,7 +359,7 @@ def build_sharded_resolver(
     return jax.jit(fn)
 
 
-class ShardedDeviceConflictSet(ConflictSet):
+class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
     """Key-partitioned ConflictSet over an N-device mesh.
 
     Equivalent to N reference Resolvers plus the proxy's verdict merge, with
@@ -365,7 +367,20 @@ class ShardedDeviceConflictSet(ConflictSet):
     rebalances online via masterserver.actor.cpp:964 resolutionBalancing;
     here rebalancing = build a new instance with new splits — resolver state
     evaporates on generation change anyway, SURVEY §5 failure detection).
+
+    Shares the single-device set's input pipeline (conflict/pipeline.py):
+    ONE bulk pack per batch feeds every shard (the batch is replicated; the
+    kernel clips per partition), and resolve_deferred gives the split-phase
+    dispatch with the same snapshot/replay recovery.
     """
+
+    _PIPELINE_SNAPSHOT_ATTRS = (
+        "_ks", "_vs", "_bidx", "_counts", "_counts_ub", "_dev_counts",
+        "_dev_ok", "_pipelined_since_check", "_last_commit", "_base",
+        "_oldest", "_cap", "_tab", "_rec_ks", "_rec_vs", "_rec_bidx",
+        "_rec_dev_counts", "_rec_counts_ub", "_rec_cap",
+        "_runs_b", "_runs_e", "_runs_ver", "_n_runs", "_run_cap",
+    )
 
     def __init__(
         self,
@@ -419,6 +434,8 @@ class ShardedDeviceConflictSet(ConflictSet):
         self._fns: dict[tuple[int, int, int, int, int], object] = {}
         self.search_fallbacks = 0
         self.regrows = 0
+        self.stats = KernelStats(backend="sharded-device")
+        self._pipeline_init()  # staging arenas + deferred-resolve window
 
         bounds = [b""] + list(split_keys)
         lo = keymod.encode_keys(bounds, max_key_bytes)
@@ -584,6 +601,13 @@ class ShardedDeviceConflictSet(ConflictSet):
             raise OverflowError("version offset overflow; call remove_before")
         return max(off, 0)
 
+    def _offset_array(self, versions: np.ndarray) -> np.ndarray:
+        """Vectorized _offset twin for the bulk packer."""
+        off = np.asarray(versions, dtype=np.int64) - self._base
+        if off.size and int(off.max()) >= 2**31 - 2**24:
+            raise OverflowError("version offset overflow; call remove_before")
+        return np.maximum(off, 0)
+
     def _fn(self, n_txn: int, n_read: int, n_write: int, search_iters: int):
         key = (
             self._cap, n_txn, n_read, n_write, search_iters,
@@ -631,6 +655,7 @@ class ShardedDeviceConflictSet(ConflictSet):
         return self._cap
 
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
+        self._drain_all()  # settle any deferred window before sync work
         validate_batch(commit_version, txns, self._oldest)
         B = len(txns)
         if B == 0:
@@ -641,9 +666,13 @@ class ShardedDeviceConflictSet(ConflictSet):
                 )
             self._last_commit = commit_version
             return []
+        t_pack = time.perf_counter()
         rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, Bp = pack_batch(
-            txns, self._oldest, self._offset, self._max_key_bytes
+            txns, self._oldest, self._offset, self._max_key_bytes,
+            arena=self._arena, stats=self.stats,
+            offset_array=self._offset_array,
         )
+        self.stats.pack_s += time.perf_counter() - t_pack
         codes = self.resolve_arrays(
             commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p
         )
@@ -661,6 +690,9 @@ class ShardedDeviceConflictSet(ConflictSet):
         sync=False: PIPELINED — dispatch and return the device verdict array
         without waiting; deferred convergence/capacity validity folds into a
         replicated device flag drained by check_pipelined()."""
+        if sync and self._inflight:
+            # mixed use: settle the deferred window first (see device.py)
+            self._drain_all()
         if commit_version <= self._last_commit:
             raise ValueError(
                 f"commit_version {commit_version} not after last batch {self._last_commit}"
@@ -932,11 +964,19 @@ class ShardedDeviceConflictSet(ConflictSet):
         off = version - self._base
         if off > 0:
             if self._lsm:
-                # range-max commutes with the monotone clamp: the cached
-                # tables clamp in place, exactly like the single-device set
-                self._vs, self._tab, self._rec_vs = _sharded_gc_lsm(
-                    self._vs, self._tab, self._rec_vs, np.int32(off)
-                )
+                if self._inflight:
+                    # a deferred window is open: the recovery snapshot may
+                    # alias these buffers — clamp WITHOUT donation
+                    o = np.int32(off)
+                    self._vs = _sharded_gc(self._vs, o)
+                    self._tab = _sharded_gc(self._tab, o)
+                    self._rec_vs = _sharded_gc(self._rec_vs, o)
+                else:
+                    # range-max commutes with the monotone clamp: the cached
+                    # tables clamp in place, like the single-device set
+                    self._vs, self._tab, self._rec_vs = _sharded_gc_lsm(
+                        self._vs, self._tab, self._rec_vs, np.int32(off)
+                    )
             else:
                 self._vs = _sharded_gc(self._vs, np.int32(off))
             if self._incremental:
@@ -944,3 +984,4 @@ class ShardedDeviceConflictSet(ConflictSet):
                 # (elementwise, so the output keeps the input's sharding)
                 self._runs_ver = _sharded_gc(self._runs_ver, np.int32(off))
             self._base = version
+            self._note_pipeline_gc(version)

@@ -12,11 +12,29 @@ C++ skip list (CPU), device kernel (TPU/XLA — the north star), or the
 mesh-sharded device set.  Resolver state evaporates on generation change —
 recovery builds a fresh Resolver (SURVEY §5), which the master accounts for
 by seeding post-recovery resolvers with oldest = recovery version.
+
+Split-phase (pipelined) resolve — opt-in via the FDBTPU_PIPELINE knob or
+the `pipeline=` constructor argument, OFF by default so deterministic
+simulation and tier-1 runs keep the synchronous path: a batch DISPATCHES
+through ConflictSet.resolve_deferred, advances the version chain
+immediately (so the next version-chained batch can pack and dispatch while
+the device still runs this one), and its verdicts are drained/replied when
+the successor dispatches — or by a bounded flush delay when the stream goes
+idle.  Verdict delivery (reply-cache insertion and replies) stays strictly
+version-ordered because at most ONE batch is parked pending at a time, and
+a duplicate delivery (proxy retry) of a version whose verdicts are still
+deferred flushes the pending batch before answering from the cache.  TOO_OLD
+floor semantics are unchanged: MVCC GC runs at dispatch time in the same
+resolve→remove_before order as the synchronous path, so batch N+1 packs
+against exactly the floor the synchronous resolver would have used.
 """
 
 from __future__ import annotations
 
-from ..conflict.api import ConflictSet, Verdict
+import dataclasses
+
+from ..conflict.api import ConflictSet, ResolveHandle, Verdict
+from ..conflict.pipeline import pipeline_enabled
 from .sequencer import NotifiedVersion
 from .types import (
     ResolutionMetricsReply,
@@ -36,6 +54,23 @@ from ..runtime.metrics import LatencyTracker
 from ..runtime.trace import CounterCollection
 
 
+# idle-stream flush bound for the split-phase path: if no successor batch
+# dispatches within this many (simulated) seconds, the parked batch drains
+# and replies itself — pipelining never delays a reply past one flush tick
+_PIPELINE_FLUSH_S = 0.0005
+
+
+@dataclasses.dataclass
+class _PendingBatch:
+    """A dispatched-but-unreplied batch in the split-phase pipeline."""
+
+    req: object
+    r: "ResolveTransactionBatchRequest"
+    handle: ResolveHandle
+    t0: float
+    moved_in: list  # moved-range guards as of dispatch (the sync path's view)
+
+
 class Resolver:
     WLT = "wlt:resolver"
     WLT_METRICS = "wlt:resolver_metrics"
@@ -47,6 +82,7 @@ class Resolver:
         knobs: CoreKnobs,
         conflict_set: ConflictSet,
         start_version: Version = 0,
+        pipeline: bool | None = None,  # None: FDBTPU_PIPELINE env, off
     ) -> None:
         self.loop = loop
         self.knobs = knobs
@@ -75,6 +111,10 @@ class Resolver:
         # their history lives on the donor, so any read below it must
         # conservatively conflict (same family as recovery state-evaporation)
         self._moved_in: list[tuple[bytes, bytes | None, Version]] = []
+        # split-phase pipeline (module docstring): at most one batch parked
+        # pending between its dispatch and its successor's dispatch
+        self._pipeline = pipeline_enabled(False) if pipeline is None else pipeline
+        self._pending: _PendingBatch | None = None
         self.metrics_stream = RequestStream(process, self.WLT_METRICS, unique=True)
         self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
         self._metrics_task = loop.spawn(
@@ -94,9 +134,17 @@ class Resolver:
         await maybe_delay(self.loop, "resolver.delay_resolve")
         await self.version.when_at_least(r.prev_version)
         if self.version.get() >= r.version:
-            # duplicate delivery (proxy retry after timeout): re-reply the
-            # cached verdicts; if evicted, conservatively abort-all so the
-            # client retries (safe: committed=false never loses data)
+            # duplicate delivery (proxy retry after timeout): the retried
+            # version's verdicts may still be deferred in the pipeline —
+            # flush the parked batch so the cache is authoritative, then
+            # re-reply the cached verdicts; if evicted, conservatively
+            # abort-all so the client retries (safe: committed=false never
+            # loses data).  Only the PENDING version needs the flush: every
+            # earlier version was finished (cache filled) before this one
+            # parked, so retries of old versions answer from cache without
+            # collapsing the pack/execute overlap.
+            if self._pending is not None and self._pending.r.version == r.version:
+                self._flush_pending()
             cached = self._reply_cache.get(r.version)
             req.reply(
                 ResolveTransactionBatchReply(
@@ -107,36 +155,91 @@ class Resolver:
             )
             return
         self._sample_load(r.transactions)
+        if self._pipeline:
+            await self._resolve_pipelined(req, r, t0)
+            return
         verdicts = self.cs.resolve_batch(r.version, r.transactions)
         if self._moved_in:
-            verdicts = self._apply_moved_in_guard(r.transactions, verdicts)
+            verdicts = self._apply_moved_in_guard(
+                self._moved_in, r.transactions, verdicts
+            )
         self.c_batches.add(1)
         self.c_txns.add(len(r.transactions))
         self.c_conflicts.add(sum(1 for v in verdicts if v == Verdict.CONFLICT))
-        # MVCC GC: versions older than the write-transaction window can no
-        # longer be checked against; raise the TooOld floor
-        window = self.knobs.mvcc_window_versions
-        if r.version > window:
-            cutoff = r.version - window
-            self.cs.remove_before(cutoff)
-            # moved-in guards expire once the TooOld floor passes them
-            self._moved_in = [m for m in self._moved_in if m[2] > cutoff]
-            # insertion order is version order: evict from the front only,
-            # O(evicted) not O(cache size) per batch
-            stale = []
-            for v in self._reply_cache:
-                if v >= cutoff:
-                    break
-                stale.append(v)
-            for v in stale:
-                del self._reply_cache[v]
+        self._advance_window(r.version)
         committed = [int(v) for v in verdicts]
         self._reply_cache[r.version] = committed
         self.version.set(r.version)
         self.latency.observe(self.loop.now() - t0)
         req.reply(ResolveTransactionBatchReply(committed=committed))
 
+    # -- split-phase pipeline (module docstring) ------------------------------
+    async def _resolve_pipelined(self, req, r, t0: float) -> None:
+        """Dispatch this batch, advance the chain, reply the PREVIOUS batch.
+
+        State transitions happen in exactly the synchronous order —
+        resolve(N) then remove_before(N's cutoff) — because dispatch and GC
+        both run here before the next batch's chain wait releases; only the
+        verdict FETCH is deferred, which is what lets batch N+1's host phase
+        (packing) overlap batch N's device execution."""
+        handle = self.cs.resolve_deferred(r.version, r.transactions)
+        pend = _PendingBatch(req, r, handle, t0, list(self._moved_in))
+        self._advance_window(r.version)  # same dispatch-order GC as sync
+        prev, self._pending = self._pending, pend
+        self.version.set(r.version)  # successor may now pack + dispatch
+        if prev is not None:
+            self._finish(prev)
+        # bounded reply delay: if no successor dispatches (and thereby
+        # finishes us) within the flush tick, drain ourselves
+        await self.loop.delay(_PIPELINE_FLUSH_S, TaskPriority.RESOLVER)
+        if self._pending is pend:
+            self._pending = None
+            self._finish(pend)
+
+    def _finish(self, pend: _PendingBatch) -> None:
+        """Drain a dispatched batch's verdicts and reply — the deferred half
+        of the synchronous path, in the same order (guard, counters, cache,
+        reply); called strictly in version order (single pending slot)."""
+        verdicts = pend.handle.wait()
+        if pend.moved_in:
+            verdicts = self._apply_moved_in_guard(
+                pend.moved_in, pend.r.transactions, verdicts
+            )
+        self.c_batches.add(1)
+        self.c_txns.add(len(pend.r.transactions))
+        self.c_conflicts.add(sum(1 for v in verdicts if v == Verdict.CONFLICT))
+        committed = [int(v) for v in verdicts]
+        self._reply_cache[pend.r.version] = committed
+        self.latency.observe(self.loop.now() - pend.t0)
+        pend.req.reply(ResolveTransactionBatchReply(committed=committed))
+
+    def _flush_pending(self) -> None:
+        if self._pending is not None:
+            pend, self._pending = self._pending, None
+            self._finish(pend)
+
+    def _advance_window(self, version: Version) -> None:
+        """MVCC GC: versions older than the write-transaction window can no
+        longer be checked against; raise the TooOld floor."""
+        window = self.knobs.mvcc_window_versions
+        if version <= window:
+            return
+        cutoff = version - window
+        self.cs.remove_before(cutoff)
+        # moved-in guards expire once the TooOld floor passes them
+        self._moved_in = [m for m in self._moved_in if m[2] > cutoff]
+        # insertion order is version order: evict from the front only,
+        # O(evicted) not O(cache size) per batch
+        stale = []
+        for v in self._reply_cache:
+            if v >= cutoff:
+                break
+            stale.append(v)
+        for v in stale:
+            del self._reply_cache[v]
+
     def stop(self) -> None:
+        self._flush_pending()  # reply any parked batch before tearing down
         self._task.cancel()
         self._metrics_task.cancel()
         self.stream.close()
@@ -155,12 +258,12 @@ class Resolver:
         if len(self._samples) > 256:
             self._samples = self._samples[::2]  # deterministic decimation
 
-    def _apply_moved_in_guard(self, txns, verdicts) -> list:
+    def _apply_moved_in_guard(self, moved_in, txns, verdicts) -> list:
         out = list(verdicts)
         for i, tx in enumerate(txns):
             if out[i] != Verdict.COMMITTED:
                 continue
-            for mb, me, mv in self._moved_in:
+            for mb, me, mv in moved_in:
                 if tx.read_snapshot < mv and any(
                     (me is None or b < me) and mb < e
                     for b, e in tx.read_ranges

@@ -79,7 +79,12 @@ def phase_main() -> None:
     for name in ("sort_ms", "scan_ms", "merge_ms", "compact_ms"):
         ms = phase[name]
         print(f"  {name:<12s} {ms:9.2f} ms  {100 * ms / total:5.1f}%")
-    print(f"  {'pack_ms':<12s} {snap['pack_ms']:9.2f} ms")
+    # host input-pipeline split (docs/KERNEL.md "Input pipeline"):
+    # pack = encode (flatten + lane encode) + pad (bucket/arena fill)
+    # + h2d (explicit device staging, populated by pipelined feeders)
+    print(f"  {'pack_ms':<12s} {snap['pack_ms']:9.2f} ms  "
+          f"(encode {snap['encode_ms']:.2f} + pad {snap['pad_ms']:.2f} + "
+          f"h2d {snap['h2d_ms']:.2f})")
     print(f"  resolve p50 {snap['resolve_ms_p50']:.2f} ms  "
           f"p99 {snap['resolve_ms_p99']:.2f} ms")
 
