@@ -214,6 +214,13 @@ class ConflictSet:
         reference's skip-list node count); 0 where a backend can't say."""
         return 0
 
+    def healthcheck(self) -> bool:
+        """Cheap liveness probe of the backend: device-backed sets force a
+        tiny host<->device round trip and raise on a sick device; pure-host
+        backends are trivially healthy.  Used by the DeviceSupervisor
+        (conflict/supervisor.py) before trusting a freshly built backend."""
+        return True
+
     def kernel_stats(self) -> dict:
         """One-shape profiling snapshot (see KernelStats); backends that
         never instrumented themselves report zeros rather than failing."""
@@ -228,6 +235,30 @@ class ConflictSet:
 
     def close(self) -> None:  # destroyConflictSet analog
         pass
+
+
+class VerdictValidationError(ValueError):
+    """A backend returned a malformed verdict list (wrong length or codes
+    outside the Verdict enum).  A dedicated type so supervisors can
+    distinguish corrupted device output from caller-side ValueErrors
+    without string matching."""
+
+
+def validate_verdicts(verdicts: Sequence, n_txn: int) -> None:
+    """Sanity-check a backend's verdict list before trusting it: exactly one
+    verdict per transaction and every code inside the enum — the cheap
+    shield that turns a corrupted device readback (garbage D2H bytes) into
+    a classified failure instead of a silently-wrong abort set."""
+    if len(verdicts) != n_txn:
+        raise VerdictValidationError(
+            f"backend returned {len(verdicts)} verdicts for {n_txn} txns"
+        )
+    for v in verdicts:
+        c = int(v)
+        if c < int(Verdict.CONFLICT) or c > int(Verdict.COMMITTED):
+            raise VerdictValidationError(
+                f"verdict code {c} outside the Verdict enum"
+            )
 
 
 def validate_batch(commit_version: int, txns: Sequence[TxInfo], oldest: int) -> None:

@@ -1534,6 +1534,15 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         a hot-path one."""
         return self.boundary_count
 
+    def healthcheck(self) -> bool:
+        """One tiny host<->device round trip through the live state arrays:
+        raises (classified by the DeviceSupervisor) if the backend is gone,
+        the tunnel is wedged, or the stream is poisoned.  The fetch is a
+        stream sync, so it only runs where a sync is already acceptable —
+        supervisor probes and fresh-construction checks, never the hot path."""
+        n = int(jnp.asarray(self._dev_count))
+        return n >= 0
+
     def _note_shape(self, key: tuple) -> None:
         if key not in self._compiled_shapes:
             self._compiled_shapes.add(key)

@@ -297,6 +297,19 @@ class PipelinedConflictMixin:
             for gv in h.gc_after:
                 self.remove_before(gv)
 
+    def abandon_inflight(self) -> None:
+        """Drop every in-flight deferred handle WITHOUT touching the device.
+
+        Called by the DeviceSupervisor when it discards a sick backend: the
+        verdicts of the open window are recomputed by the supervisor's CPU
+        replay, so fetching them here (a device round trip that may hang or
+        raise on a lost device) must never happen — not even from close().
+        After this, resolve/GC calls on this set are undefined; the owner
+        is expected to drop the whole object."""
+        self._inflight.clear()
+        self._replayable.clear()
+        self._pipe_snapshot = None
+
     def _note_pipeline_gc(self, version: int) -> None:
         """remove_before while batches are in flight: record the call on the
         newest dispatch so a recovery replays it at the right point."""

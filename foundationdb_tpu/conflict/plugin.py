@@ -120,6 +120,11 @@ class PluginConflictSet(ConflictSet):
         txn-by-txn: read (b,e)* then write (b,e)*).  Counterpart of
         DeviceConflictSet.resolve_arrays for marshal-free benchmarking and
         the packed proxy->resolver wire format."""
+        if not self._handle:
+            # a closed/destroyed plugin handle must fail loudly, not hand a
+            # NULL pointer to the C ABI (a segfault the supervisor could
+            # never classify)
+            raise RuntimeError("conflict plugin handle closed")
         n = snapshots.shape[0]
         verdicts = np.zeros(max(n, 1), dtype=np.uint8)
         t0 = time.perf_counter()
@@ -153,6 +158,8 @@ class PluginConflictSet(ConflictSet):
         return verdicts[:n]
 
     def remove_before(self, version: int) -> None:
+        if not self._handle:
+            raise RuntimeError("conflict plugin handle closed")
         if version > self._oldest:
             self._oldest = version
             t0 = time.perf_counter()
@@ -164,6 +171,8 @@ class PluginConflictSet(ConflictSet):
 
     @property
     def node_count(self) -> int:
+        if not self._handle:
+            raise RuntimeError("conflict plugin handle closed")
         return int(self._lib.fdbtpu_conflictset_node_count(self._handle))
 
     def close(self) -> None:

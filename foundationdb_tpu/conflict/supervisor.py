@@ -1,0 +1,825 @@
+"""DeviceSupervisor — the hardware conflict backend behind a circuit breaker.
+
+The plugin boundary's promise (PAPER.md: the TPU kernel is an *optimization*
+"so the commit path and a CPU reference implementation remain intact") is
+only real if a sick device cannot take the resolver down with it.  This
+module makes that promise enforceable: every device interaction — compile
+probe, dispatch, deferred readback, GC, state replay — runs under a bounded
+watchdog with knob-controlled retry + exponential backoff (the
+DEFAULT_BACKOFF family, runtime/knobs.py DEVICE_*), and after
+DEVICE_RETRY_LIMIT consecutive failures a circuit breaker trips and the
+resolver **degrades gracefully to the CPU reference backend**:
+
+  * the supervisor keeps a committed-write-window record — (commit_version,
+    committed write ranges) for every batch inside the MVCC window, the
+    same snapshot/replay discipline conflict/pipeline.py uses for
+    deferred-failure recovery, lifted ABOVE the device so it survives full
+    device loss (including loss mid-pipeline with a deferred window open);
+  * on degrade it reconstructs an equivalent ``oracle``/``native``
+    ConflictSet by replaying that record (write-only batches commute with
+    GC, so the rebuild is exact), replays any open deferred window through
+    it in dispatch order with the recorded GC interleaving, and keeps
+    serving version-ordered verdicts — zero transactions aborted in error;
+  * while degraded it re-probes the device every DEVICE_REPROBE_INTERVAL
+    (virtual clock under simulation via ``bind_clock``, wall clock on the
+    real network) and re-promotes by rebuilding device state from the
+    record; the handoff is trusted only after an abort-set parity check on
+    the first promoted batch (device and CPU both resolve it; any mismatch
+    demotes again).
+
+Failure classes (``classify_failure``): hang (watchdog), lost (runtime /
+tunnel death), compile_fail, readback_corrupt (validate_verdicts or parity
+mismatch), no_device.  Each is injectable under simulation via the buggify
+sites ``device.dispatch_hang``, ``device.lost``, ``device.compile_fail``,
+``device.readback_corrupt`` so the chaos sweep can kill the device at
+arbitrary points in the split-phase pipeline.  Health feeds
+``rpc/failmon.py`` (``note_device``) and ``control/status.py``
+(state / trip counts / time degraded) — docs/OPERATIONS.md has the
+degraded-mode runbook.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+from .api import (
+    CompletedResolve,
+    ConflictSet,
+    ResolveHandle,
+    TxInfo,
+    Verdict,
+    VerdictValidationError,
+    validate_verdicts,
+)
+from ..runtime.buggify import buggify
+from ..runtime.coverage import testcov
+
+
+class DeviceError(RuntimeError):
+    """Base class of classified device-backend failures."""
+
+    failure_class = "error"
+
+
+class DeviceHang(DeviceError):
+    failure_class = "hang"
+
+
+class DeviceLost(DeviceError):
+    failure_class = "lost"
+
+
+class DeviceCompileFail(DeviceError):
+    failure_class = "compile_fail"
+
+
+class DeviceReadbackCorrupt(DeviceError):
+    failure_class = "readback_corrupt"
+
+
+# substrings that classify an unstructured backend error (JAX/PJRT raise
+# plain RuntimeError/XlaRuntimeError; the tunnel's death shows up as
+# UNAVAILABLE / connection text, a missing accelerator as init failures)
+_CLASS_PATTERNS = (
+    ("no_device", (
+        "no visible device", "unable to initialize backend",
+        "failed to initialize", "no devices", "device not found",
+        "backend 'tpu' requested",
+    )),
+    ("compile_fail", ("compil", "lowering", "mosaic", "unsupported hlo")),
+    ("lost", (
+        "unavailable", "connection", "socket closed", "deadline exceeded",
+        "device lost", "reset by peer", "data loss", "internal:",
+    )),
+)
+
+
+def classify_failure(err) -> str:
+    """Map an exception (or error text) to a failure class: one of
+    hang | lost | compile_fail | readback_corrupt | no_device | error.
+    Shared by the supervisor and the bench device probe so operators see
+    ONE vocabulary in probe.log, status, and traces."""
+    if isinstance(err, DeviceError):
+        return err.failure_class
+    if isinstance(err, TimeoutError):
+        return "hang"
+    text = str(err).lower()
+    for cls, pats in _CLASS_PATTERNS:
+        if any(p in text for p in pats):
+            return cls
+    return "error"
+
+
+class Watchdog:
+    """Bounded execution of a (possibly blocking) device call.
+
+    wall=True runs the call on a persistent single worker thread and raises
+    DeviceHang past ``timeout_s`` — the real-network mode where a hung PJRT
+    dispatch must not wedge the resolver (the wedged daemon worker is
+    abandoned and replaced; the caller quarantines the device).
+    wall=False (the simulation default) calls inline: deterministic sims
+    cannot thread, so hangs there are *injected* as DeviceHang by the
+    ``device.dispatch_hang`` buggify site instead — virtual-clock
+    supervision with the same downstream handling."""
+
+    def __init__(self, timeout_s: float | None, wall: bool = False) -> None:
+        self.timeout_s = timeout_s
+        self.wall = wall
+        self._worker = None
+        self._q = None
+
+    @staticmethod
+    def _serve(q) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised by run()
+                box["error"] = e
+            finally:
+                done.set()
+
+    def run(self, fn: Callable):
+        if not self.wall or not self.timeout_s:
+            return fn()
+        # ONE persistent DAEMON worker: the hot path pays a queue hop per
+        # call, not a thread spawn.  Daemon matters — a wedged worker must
+        # never be joined again, not by us and not by the interpreter
+        # (ThreadPoolExecutor workers are non-daemon and the
+        # concurrent.futures atexit hook joins them, which would turn one
+        # tripped watchdog into a process that can never exit).
+        import queue
+        import threading
+
+        if self._worker is None or not self._worker.is_alive():
+            self._q = queue.SimpleQueue()
+            self._worker = threading.Thread(
+                target=self._serve, args=(self._q,), daemon=True
+            )
+            self._worker.start()
+        box: dict = {}
+        done = threading.Event()
+        self._q.put((fn, box, done))
+        if not done.wait(self.timeout_s):
+            # abandon the wedged worker: its queue gets no more work, so if
+            # it ever unwedges it parks on an empty queue until process exit
+            self._worker = None
+            self._q = None
+            raise DeviceHang(
+                f"device call exceeded watchdog {self.timeout_s:.0f}s"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def close(self) -> None:
+        if self._q is not None:
+            self._q.put(None)  # let an idle worker exit promptly
+        self._worker = None
+        self._q = None
+
+
+class _WinEntry:
+    """One dispatched batch of the supervised deferred window: enough to
+    replay it through the CPU fallback if the device dies before (or
+    while) its verdicts are read.  ``gc_after`` holds the remove_before
+    floors issued while this entry was the newest dispatch — i.e. after
+    this batch resolved on the device and before its successor did."""
+
+    __slots__ = ("version", "txns", "inner", "gc_after", "result")
+
+    def __init__(self, version: int, txns, inner: ResolveHandle) -> None:
+        self.version = version
+        self.txns = txns            # () once recorded
+        self.inner = inner
+        self.gc_after: list[int] = []
+        self.result: list[Verdict] | None = None
+
+
+class SupervisedHandle(ResolveHandle):
+    """ResolveHandle whose wait() routes through the supervisor so a device
+    failure during readback degrades and recovers the whole window."""
+
+    __slots__ = ("_sup", "_entry")
+
+    def __init__(self, sup: "DeviceSupervisor", entry: _WinEntry) -> None:
+        self._sup = sup
+        self._entry = entry
+
+    def wait(self) -> list[Verdict]:
+        return self._sup._wait_entry(self._entry)
+
+
+class DeviceSupervisor(ConflictSet):
+    """ConflictSet that supervises a device-backed implementation and
+    degrades to a CPU reference backend rather than failing.
+
+    ``device_factory(oldest_version)`` builds the supervised backend
+    (DeviceConflictSet / ShardedDeviceConflictSet / a plugin);
+    ``fallback_factory(oldest_version)`` builds the CPU reference
+    (OracleConflictSet by default; conflict.native.NativeConflictSet where
+    the C++ skip list is built).  ``knobs`` supplies the DEVICE_* family;
+    ``clock`` paces backoff/re-probe scheduling (time.monotonic by default —
+    the Resolver rebinds it to the sim loop's virtual clock via
+    ``bind_clock``, so supervision is deterministic under simulation)."""
+
+    def __init__(
+        self,
+        device_factory: Callable[[int], ConflictSet],
+        *,
+        fallback_factory: Callable[[int], ConflictSet] | None = None,
+        oldest_version: int = 0,
+        knobs=None,
+        clock: Callable[[], float] | None = None,
+        wall_watchdog: bool = False,
+        name: str = "device",
+    ) -> None:
+        import os
+
+        from ..runtime.knobs import CoreKnobs
+        from .oracle import OracleConflictSet
+
+        self.name = name
+        self._device_factory = device_factory
+        self._fallback_factory = fallback_factory or (
+            lambda oldest=0: OracleConflictSet(oldest)
+        )
+        k = knobs or CoreKnobs()
+        self.watchdog_s = float(k.DEVICE_WATCHDOG_S)
+        self.retry_limit = int(k.DEVICE_RETRY_LIMIT)
+        self.backoff0 = float(k.DEVICE_RETRY_BACKOFF)
+        self.max_backoff = float(k.DEVICE_MAX_BACKOFF)
+        self.reprobe_interval = float(k.DEVICE_REPROBE_INTERVAL)
+        self._clock = clock or time.monotonic
+        self._watchdog = Watchdog(self.watchdog_s, wall=wall_watchdog)
+
+        # committed-write-window record: [(version, ((b, e), ...)), ...]
+        # ascending; the CPU/device rebuild source of truth.  `_floor` is
+        # the reported TooOld floor (advances on every remove_before);
+        # `_record_floor` is the floor the RECORD is pruned to — it lags
+        # while a deferred window is open so a mid-window rebuild can
+        # replay each open batch at its dispatch-time floor.
+        self._record: list[tuple[int, tuple[tuple[bytes, bytes], ...]]] = []
+        self._floor = oldest_version
+        self._record_floor = oldest_version
+        self._window: list[_WinEntry] = []
+
+        # health / breaker state
+        self._state = "healthy"
+        self._fails = 0          # consecutive failures since last success
+        self._trips = 0          # breaker trips (healthy -> degraded)
+        self._promotions = 0
+        self._probes = 0
+        self._last_failure: str | None = None
+        self._degraded_since: float | None = None
+        self._time_degraded = 0.0
+        self._suspect = False    # device stale/quarantined, breaker not tripped
+        self._parity_pending = False
+        self._forced = False
+        self._backoff = self.backoff0
+        self._next_attempt = self._clock()  # earliest next device (re)build
+        self._failmon = None
+        self._failmon_name = name
+
+        self._cpu: ConflictSet | None = None
+        self._dev: ConflictSet | None = None
+        # device construction is LAZY: the first resolve probes and promotes
+        # (parity-checked), AFTER the owning role has had the chance to
+        # bind_clock()/enable_wall_watchdog() — a construction-time probe
+        # would run the very first (and historically hang-prone) PJRT init
+        # unbounded, before any watchdog could be armed
+        if os.environ.get("FDBTPU_FORCE_DEGRADE", "") == "1":
+            # operator force-degrade knob (docs/OPERATIONS.md): start on the
+            # CPU reference and stay there until force_promote()
+            self.force_degrade()
+
+    # -- wiring ---------------------------------------------------------------
+    def bind_clock(self, clock: Callable[[], float]) -> None:
+        """Re-anchor backoff/re-probe pacing to a different clock (the sim
+        loop's virtual now()); called by the Resolver at construction."""
+        self._clock = clock
+        self._next_attempt = clock()
+        if self._degraded_since is not None:
+            self._degraded_since = clock()
+
+    def bind_failmon(self, failmon, name: str | None = None) -> None:
+        """Feed device health transitions into the cluster failure monitor."""
+        self._failmon = failmon
+        if name is not None:
+            self._failmon_name = name
+        self._feed_failmon()
+
+    def enable_wall_watchdog(self) -> None:
+        """Switch the watchdog to wall-clock worker-thread enforcement —
+        called by the Resolver when it finds itself on the REAL network
+        (threads are forbidden under deterministic simulation, where hangs
+        are injected virtually instead)."""
+        if not self._watchdog.wall:
+            self._watchdog.close()
+            self._watchdog = Watchdog(self.watchdog_s, wall=True)
+
+    # -- ConflictSet surface --------------------------------------------------
+    @property
+    def oldest_version(self) -> int:
+        return self._floor
+
+    @property
+    def node_count(self) -> int:
+        be = self._active_backend()
+        try:
+            # watchdog-bounded: node_count forces a device scalar fetch,
+            # and a status scrape must never hang on a wedged tunnel
+            return (
+                int(self._watchdog.run(lambda: be.node_count))
+                if be is not None else 0
+            )
+        except Exception:  # noqa: BLE001 — a sick device must not wedge status
+            return 0
+
+    def kernel_stats(self) -> dict:
+        be = self._active_backend()
+        if be is None:
+            snap = super().kernel_stats()
+        else:
+            try:
+                snap = self._watchdog.run(be.kernel_stats)
+            except Exception:  # noqa: BLE001 — status scrape on a dying device
+                snap = super().kernel_stats()
+        snap["supervisor"] = self.health()
+        return snap
+
+    def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
+        if self._window and self._device_serving():
+            # mixed use: a deferred window is open — settle EVERY entry, in
+            # order, before sync work so the record stays version-ordered
+            # (the device mixin drains its own stream the same way)
+            for e in list(self._window):
+                self._wait_entry(e)
+        self._settle_window()
+        self._maybe_attempt_device()
+        if self._device_serving():
+            if self._parity_pending:
+                return self._resolve_parity(commit_version, txns)
+            try:
+                verdicts = self._guard(
+                    "dispatch",
+                    lambda: self._dev.resolve_batch(commit_version, txns),
+                )
+                verdicts = self._inject_corrupt(verdicts)
+                validate_verdicts(verdicts, len(txns))
+            except Exception as e:  # noqa: BLE001 — classified or re-raised
+                self._classify_or_reraise("dispatch", e)
+                self._recover_window()
+            else:
+                self._note_success()
+                self._record_batch(commit_version, txns, verdicts)
+                return verdicts
+        return self._cpu_resolve(commit_version, txns)
+
+    def resolve_deferred(self, commit_version: int, txns: Sequence[TxInfo]) -> ResolveHandle:
+        self._maybe_attempt_device()
+        # parity batches resolve synchronously (both backends must see them)
+        if not self._device_serving() or self._parity_pending:
+            return CompletedResolve(self.resolve_batch(commit_version, txns))
+        try:
+            inner = self._guard(
+                "dispatch",
+                lambda: self._dev.resolve_deferred(commit_version, txns),
+            )
+        except Exception as e:  # noqa: BLE001 — device died at dispatch
+            self._classify_or_reraise("dispatch", e)
+            self._recover_window()
+            return CompletedResolve(self._cpu_resolve(commit_version, txns))
+        entry = _WinEntry(commit_version, list(txns), inner)
+        self._window.append(entry)
+        if isinstance(inner, CompletedResolve):
+            # the backend fell through to a synchronous resolve internally
+            # (empty batch / capacity margin): verdicts are already final —
+            # complete through this entry now so the record stays ordered
+            self._wait_entry(entry)
+        return SupervisedHandle(self, entry)
+
+    def remove_before(self, version: int) -> None:
+        if version <= self._floor:
+            return
+        self._floor = version
+        if self._window:
+            # defer record pruning: a mid-window rebuild must replay each
+            # open batch at its dispatch-time floor (same discipline as
+            # pipeline.py _note_pipeline_gc)
+            self._window[-1].gc_after.append(version)
+        else:
+            self._apply_record_floor(version)
+        if self._cpu is not None:
+            self._cpu.remove_before(version)
+        if self._device_serving():
+            try:
+                self._guard("gc", lambda: self._dev.remove_before(version))
+            except Exception as e:  # noqa: BLE001 — classified device failure
+                self._classify_or_reraise("gc", e)
+                self._recover_window()
+
+    def healthcheck(self) -> bool:
+        be = self._active_backend()
+        return self._watchdog.run(be.healthcheck) if be is not None else True
+
+    def close(self) -> None:
+        self._watchdog.close()
+        for be in (self._dev, self._cpu):
+            if be is not None:
+                try:
+                    be.close()
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    pass
+        self._dev = self._cpu = None
+
+    # -- health surface -------------------------------------------------------
+    def health(self) -> dict:
+        t_deg = self._time_degraded
+        if self._degraded_since is not None:
+            t_deg += self._clock() - self._degraded_since
+        serving_device = self._device_serving() and not self._parity_pending
+        return {
+            "state": self._state,
+            # while a parity check is pending the CPU's verdicts are what
+            # gets served, so that is what the field reports
+            "serving": "device" if serving_device else "cpu",
+            "trips": self._trips,
+            "consecutive_failures": self._fails,
+            "last_failure": self._last_failure,
+            "time_degraded_s": t_deg,
+            "probes": self._probes,
+            "promotions": self._promotions,
+            "recorded_batches": len(self._record),
+        }
+
+    def force_degrade(self) -> None:
+        """Operator knob: drop to the CPU reference now and stop re-probing
+        (until force_promote()).  Safe at any point — an open deferred
+        window is recovered exactly like an injected device loss."""
+        self._ensure_cpu()
+        if self._window:
+            self._recover_window()
+        self._drop_device()
+        if self._state != "degraded":
+            self._state = "degraded"
+            self._trips += 1
+            self._degraded_since = self._clock()
+            self._feed_failmon()
+        self._forced = True
+        testcov("device.force_degrade")
+
+    def force_promote(self) -> None:
+        """Operator knob: clear a force_degrade and re-probe immediately
+        (the promotion still passes through the parity check)."""
+        self._forced = False
+        self._next_attempt = self._clock()
+        self._maybe_attempt_device()
+
+    # -- internals ------------------------------------------------------------
+    def _device_serving(self) -> bool:
+        return self._dev is not None and not self._suspect
+
+    def _active_backend(self) -> ConflictSet | None:
+        return self._dev if self._device_serving() else self._cpu
+
+    def _guard(self, op: str, fn: Callable):
+        """One supervised device interaction: buggify fault injection first
+        (simulation), then the bounded watchdog around the real call."""
+        if buggify("device.lost"):
+            raise DeviceLost("buggify: device lost")
+        if op in ("dispatch", "readback") and buggify("device.dispatch_hang"):
+            raise DeviceHang(
+                f"buggify: dispatch hung past watchdog {self.watchdog_s:.0f}s"
+            )
+        if op in ("dispatch", "probe") and buggify("device.compile_fail"):
+            raise DeviceCompileFail("buggify: kernel compile failed")
+        return self._watchdog.run(fn)
+
+    def _inject_corrupt(self, verdicts: list):
+        if buggify("device.readback_corrupt"):
+            # garbage D2H bytes: out-of-enum codes that validate_verdicts
+            # must catch (the detection path, not just the injection)
+            return [7] * len(verdicts)
+        return verdicts
+
+    def _classify_or_reraise(self, op: str, e: Exception) -> None:
+        """Device failures are absorbed and counted; caller bugs (bad
+        versions / malformed ranges) re-raise — the supervisor must never
+        turn an API misuse into a silent degrade."""
+        if isinstance(e, VerdictValidationError):
+            # malformed verdicts ARE a device failure (corrupt readback)
+            self._note_failure(op, DeviceReadbackCorrupt(str(e)))
+            return
+        if isinstance(e, (ValueError, TypeError)) and not isinstance(e, DeviceError):
+            raise e
+        self._note_failure(op, e)
+
+    def _note_failure(self, op: str, err) -> None:
+        cls = classify_failure(err)
+        self._last_failure = f"{op}:{cls}"
+        self._fails += 1
+        self._suspect = True
+        self._parity_pending = False
+        testcov(f"device.fail.{cls}")
+        # first retry waits the knob value itself; doubling applies from
+        # the second consecutive failure on
+        self._next_attempt = self._clock() + (
+            self._backoff if self._state != "degraded" else self.reprobe_interval
+        )
+        self._backoff = min(self._backoff * 2, self.max_backoff)
+        if self._fails >= self.retry_limit and self._state != "degraded":
+            self._trip()
+        else:
+            # keep the failure monitor current on every failure — a failed
+            # re-probe must not leave it frozen at "probing"
+            self._feed_failmon()
+
+    def _note_success(self) -> None:
+        self._fails = 0
+        self._backoff = self.backoff0
+
+    def _trip(self) -> None:
+        """Circuit breaker: stop hammering the device, serve from the CPU
+        reference, re-probe on the slow cadence."""
+        self._drop_device()
+        self._ensure_cpu()
+        self._state = "degraded"
+        self._trips += 1
+        self._degraded_since = self._clock()
+        self._next_attempt = self._clock() + self.reprobe_interval
+        testcov("device.degraded")
+        self._feed_failmon()
+
+    def _drop_device(self) -> None:
+        dev, self._dev = self._dev, None
+        self._suspect = False
+        if dev is not None:
+            try:
+                if hasattr(dev, "abandon_inflight"):
+                    dev.abandon_inflight()
+                dev.close()
+            except Exception:  # noqa: BLE001 — it is being discarded
+                pass
+
+    def _feed_failmon(self) -> None:
+        if self._failmon is not None and hasattr(self._failmon, "note_device"):
+            self._failmon.note_device(self._failmon_name, self.health())
+
+    # -- record / fallback ----------------------------------------------------
+    def _record_batch(self, version: int, txns, verdicts) -> None:
+        writes: list[tuple[bytes, bytes]] = []
+        for tx, v in zip(txns, verdicts):
+            if int(v) == int(Verdict.COMMITTED):
+                writes.extend(tx.write_ranges)
+        if writes:
+            self._record.append((version, tuple(writes)))
+
+    def _apply_record_floor(self, version: int) -> None:
+        if version <= self._record_floor:
+            return
+        self._record_floor = version
+        # writes at v < floor can never conflict again (any live snapshot
+        # is >= floor > v): prune from the front (versions ascend)
+        i = 0
+        while i < len(self._record) and self._record[i][0] < version:
+            i += 1
+        if i:
+            del self._record[:i]
+
+    def _replay_record(self, cs: ConflictSet) -> None:
+        """Rebuild a backend from the committed-write record: write-only
+        batches (no reads => no conflicts, no TooOld dependence) commute
+        with GC, so replaying every batch at floor 0 and applying the
+        record floor once at the end reconstructs the exact step function."""
+        for version, writes in self._record:
+            cs.resolve_batch(
+                version,
+                [TxInfo(read_snapshot=version - 1, read_ranges=(),
+                        write_ranges=writes)],
+            )
+        if self._record_floor > cs.oldest_version:
+            cs.remove_before(self._record_floor)
+
+    def _ensure_cpu(self) -> ConflictSet:
+        if self._cpu is None:
+            cs = self._fallback_factory(0)
+            self._replay_record(cs)
+            if not self._window and self._floor > cs.oldest_version:
+                cs.remove_before(self._floor)
+            self._cpu = cs
+            testcov("device.cpu_rebuild")
+        return self._cpu
+
+    def _cpu_resolve(self, commit_version: int, txns) -> list[Verdict]:
+        verdicts = self._ensure_cpu().resolve_batch(commit_version, txns)
+        self._record_batch(commit_version, txns, verdicts)
+        return verdicts
+
+    # -- deferred window ------------------------------------------------------
+    def _wait_entry(self, entry: _WinEntry) -> list[Verdict]:
+        if entry.result is not None:
+            if entry.txns:
+                # completed but not yet recorded (a CompletedResolve behind
+                # a still-in-flight predecessor): try to finish the prefix
+                # so the record never interleaves out of version order
+                self._complete_prefix(entry)
+            return list(entry.result)
+        try:
+            verdicts = self._guard("readback", entry.inner.wait)
+            verdicts = self._inject_corrupt(verdicts)
+            validate_verdicts(verdicts, len(entry.txns))
+        except Exception as e:  # noqa: BLE001 — classified or re-raised
+            self._classify_or_reraise("readback", e)
+            self._recover_window()
+            assert entry.result is not None
+            return list(entry.result)
+        entry.result = list(verdicts)
+        self._note_success()
+        self._complete_prefix(entry)
+        return list(entry.result)
+
+    def _entry_done(self, e: _WinEntry) -> bool:
+        """True if e's inner verdicts are already host-resident (the device
+        mixin drains in dispatch order, so waiting a later handle settles
+        earlier ones)."""
+        if isinstance(e.inner, CompletedResolve):
+            return True
+        return getattr(e.inner, "_result", None) is not None
+
+    def _complete_prefix(self, upto: _WinEntry) -> None:
+        """Record (in dispatch order) every window entry whose verdicts are
+        now known, through `upto`; then pop the recorded prefix."""
+        for e in self._window:
+            if e.result is None:
+                if e is upto or self._entry_done(e):
+                    # `upto` was validated by the caller; earlier settled
+                    # entries are fetched here and must pass the SAME
+                    # validation (and chaos injection) — a corrupt
+                    # readback must never slip through this side door
+                    verdicts = self._inject_corrupt(list(e.inner.wait()))
+                    try:
+                        validate_verdicts(verdicts, len(e.txns))
+                    except ValueError as ex:
+                        self._note_failure(
+                            "readback", DeviceReadbackCorrupt(str(ex))
+                        )
+                        self._recover_window()
+                        return
+                    e.result = verdicts
+                else:
+                    break
+            if e.txns:
+                self._record_batch(e.version, e.txns, e.result)
+                e.txns = ()
+            if e is upto:
+                break
+        self._settle_window()
+
+    def _settle_window(self) -> None:
+        """Pop the recorded prefix, applying each popped entry's deferred
+        GC floors to the record — those floors were issued after the entry
+        resolved and before its successor dispatched, so once the entry is
+        recorded every remaining batch was dispatched above them."""
+        while (
+            self._window
+            and self._window[0].result is not None
+            and not self._window[0].txns
+        ):
+            e = self._window.pop(0)
+            for g in e.gc_after:
+                self._apply_record_floor(g)
+        if not self._window:
+            self._apply_record_floor(self._floor)
+
+    def _recover_window(self) -> None:
+        """Full device loss with a deferred window open: rebuild the CPU
+        reference from the record (pruned only to the pre-window floor),
+        then replay every open batch in dispatch order with its recorded
+        GC interleaving — completed batches re-apply their known committed
+        writes, uncompleted ones get their verdicts from the CPU replay.
+        The verdict stream is identical to what a healthy device would
+        have produced (the CPU reference IS the parity oracle the device
+        kernel is tested against)."""
+        cpu = self._ensure_cpu()  # while the window is still visible
+        if not self._window:
+            return
+        testcov("device.window_recover")
+        window, self._window = self._window, []
+        for e in window:
+            if e.result is None:
+                e.result = cpu.resolve_batch(e.version, e.txns)
+                self._record_batch(e.version, e.txns, e.result)
+                e.txns = ()
+            elif e.txns:
+                # verdicts were read (device-validated) but not recorded:
+                # re-apply the committed writes to the rebuilt CPU set
+                cpu.resolve_batch(
+                    e.version,
+                    [
+                        TxInfo(e.version - 1, (), tx.write_ranges)
+                        for tx, v in zip(e.txns, e.result)
+                        if int(v) == int(Verdict.COMMITTED)
+                    ],
+                )
+                self._record_batch(e.version, e.txns, e.result)
+                e.txns = ()
+            for g in e.gc_after:
+                cpu.remove_before(g)
+                self._apply_record_floor(g)
+        self._apply_record_floor(self._floor)
+        if cpu.oldest_version < self._floor:
+            cpu.remove_before(self._floor)
+
+    # -- re-probe / promotion -------------------------------------------------
+    def _maybe_attempt_device(self) -> None:
+        if self._device_serving() or self._forced:
+            return
+        if self._clock() < self._next_attempt:
+            return
+        self._try_promote()
+
+    def _try_promote(self) -> None:
+        """Probe the device and hand state back up: fresh backend, record
+        replay, then arm the parity check — the promotion is trusted only
+        once the first promoted batch's abort set matches the CPU's."""
+        self._probes += 1
+        prev_state, self._state = self._state, "probing"
+        self._feed_failmon()
+        testcov("device.probe")
+        try:
+            self._drop_device()
+            dev = self._guard("probe", lambda: self._device_factory(0))
+            self._guard("probe", dev.healthcheck)
+            self._dev = dev
+            self._guard("promote", lambda: self._replay_record(dev))
+            if self._floor > dev.oldest_version:
+                self._guard("gc", lambda: dev.remove_before(self._floor))
+        except Exception as e:  # noqa: BLE001 — classified device failure
+            self._drop_device()
+            self._state = prev_state
+            self._note_failure("probe", e)
+            return
+        self._state = prev_state  # healthy only after the parity batch
+        self._suspect = False
+        self._parity_pending = True
+
+    def _resolve_parity(self, commit_version: int, txns) -> list[Verdict]:
+        """First post-promotion batch: device and CPU reference both
+        resolve it and the abort sets must agree bit-for-bit before the
+        device is trusted (state-handoff verification).  The CPU's
+        verdicts are what gets served either way, so even a lying device
+        aborts nothing in error.  An EMPTY batch proves nothing — the
+        check stays armed until the first batch with transactions."""
+        vacuous = len(txns) == 0
+        cpu = self._ensure_cpu()
+        dev_verdicts = None
+        try:
+            dev_verdicts = self._guard(
+                "dispatch",
+                lambda: self._dev.resolve_batch(commit_version, txns),
+            )
+            dev_verdicts = self._inject_corrupt(dev_verdicts)
+            validate_verdicts(dev_verdicts, len(txns))
+        except Exception as e:  # noqa: BLE001 — classified or re-raised
+            # a re-raised caller bug leaves the parity check ARMED: the
+            # device must not become trusted off a batch that never ran
+            self._classify_or_reraise("promote", e)
+            dev_verdicts = None
+        self._parity_pending = False
+        cpu_verdicts = cpu.resolve_batch(commit_version, txns)
+        self._record_batch(commit_version, txns, cpu_verdicts)
+        if dev_verdicts is None:
+            return cpu_verdicts
+        if [int(v) for v in dev_verdicts] != [int(v) for v in cpu_verdicts]:
+            self._note_failure(
+                "promote",
+                DeviceReadbackCorrupt(
+                    "post-promotion parity mismatch vs CPU reference"
+                ),
+            )
+            return cpu_verdicts
+        if vacuous:
+            self._parity_pending = True  # nothing was verified; stay armed
+            return cpu_verdicts
+        # parity holds: the device is authoritative again.  Drop the CPU
+        # set (the record stays — it is the rebuild source for the NEXT
+        # degrade) and close the degraded-time accounting window.
+        self._promotions += 1
+        if self._degraded_since is not None:
+            self._time_degraded += self._clock() - self._degraded_since
+            self._degraded_since = None
+        self._state = "healthy"
+        self._note_success()
+        cpu_set, self._cpu = self._cpu, None
+        try:
+            cpu_set.close()
+        except Exception:  # noqa: BLE001
+            pass
+        testcov("device.promoted")
+        self._feed_failmon()
+        return cpu_verdicts

@@ -1050,10 +1050,14 @@ class ClusterController:
             p = self._new_proc(f"resolver{i}")
             procs.append(p)
             add_ping(p)
+            cs = self.make_cs(recovery_version)
+            if hasattr(cs, "bind_failmon"):
+                # supervised device backend: its degraded/healthy/probing
+                # transitions land in the cluster-wide failure monitor
+                cs.bind_failmon(self.failure_monitor, f"resolver{i}.device")
             resolvers.append(
                 Resolver(
-                    p, self.loop, self.knobs,
-                    self.make_cs(recovery_version),
+                    p, self.loop, self.knobs, cs,
                     start_version=recovery_version + 1_000_000,
                 )
             )

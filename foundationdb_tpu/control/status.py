@@ -19,6 +19,41 @@ def _severity_name(sev: int) -> str:
     )
 
 
+def _device_messages(resolvers) -> list[dict[str, Any]]:
+    """A degraded or probing device backend is exactly the kind of
+    'cluster serves but you should know' condition cluster.messages exists
+    for (the runbook entry point: docs/OPERATIONS.md)."""
+    msgs: list[dict[str, Any]] = []
+    for i, r in enumerate(resolvers):
+        h = getattr(r.cs, "health", None)
+        if h is None:
+            continue
+        health = h()
+        # message while NOT fully recovered: degraded/probing state, live
+        # failure streak, or still serving from the CPU after a trip.  A
+        # fresh resolver that hasn't probed yet (lazy first promotion) and
+        # a fully re-promoted one (healthy, serving device) stay silent —
+        # an empty message list must mean healthy.
+        if (
+            health["state"] != "healthy"
+            or health["consecutive_failures"]
+            or (health["serving"] == "cpu" and health["trips"])
+        ):
+            msgs.append({
+                "name": "device_backend_degraded",
+                "severity": "warn",
+                "time": None,
+                "description": (
+                    f"resolver{i} conflict backend {health['state']}"
+                    f" (serving {health['serving']},"
+                    f" trips {health['trips']},"
+                    f" last_failure {health['last_failure']},"
+                    f" degraded {health['time_degraded_s']:.3f}s)"
+                ),
+            })
+    return msgs
+
+
 def _messages(trace, ratekeeper) -> list[dict[str, Any]]:
     """Operator-facing message list (the reference status doc's
     cluster.messages): every SEV_WARN+ `track_latest` snapshot becomes a
@@ -88,6 +123,22 @@ def _kernel_rollup(resolvers) -> dict[str, Any]:
     )
     for k in ("resolve_ms_p50", "resolve_ms_p99"):
         out[k] = max(p[k] for p in per)
+    sup = [p["supervisor"] for p in per if "supervisor" in p]
+    if sup:
+        # supervised device backends (conflict/supervisor.py): one roll-up
+        # of the degraded/healthy/probing fleet — counts by state, total
+        # breaker trips, and the worst time-in-degraded
+        out["device"] = {
+            "states": {
+                s: sum(1 for h in sup if h["state"] == s)
+                for s in ("healthy", "probing", "degraded")
+            },
+            "serving_cpu": sum(1 for h in sup if h["serving"] == "cpu"),
+            "trips": sum(h["trips"] for h in sup),
+            "promotions": sum(h["promotions"] for h in sup),
+            "probes": sum(h["probes"] for h in sup),
+            "time_degraded_s": max(h["time_degraded_s"] for h in sup),
+        }
     return out
 
 
@@ -184,7 +235,7 @@ def cluster_status(cluster) -> dict[str, Any]:
     doc["kernel"] = _kernel_rollup(resolvers)
 
     rk = getattr(cluster, "ratekeeper", None)
-    doc["cluster"]["messages"] = _messages(trace, rk)
+    doc["cluster"]["messages"] = _messages(trace, rk) + _device_messages(resolvers)
 
     dd = getattr(cluster, "dd", None)
     if dd is not None:
@@ -209,10 +260,16 @@ def cluster_status(cluster) -> dict[str, Any]:
             if controller.replication_policy is not None else None,
             "team_sizes": [len(t) for t in controller.storage_teams_tags],
         }
+        devices = fm.device_report()
         doc["cluster"]["failure_monitor"] = {
             "tracked": len(fm._status),
             "failed": [str(a) for a in fm.failed_addresses()],
             "transitions": fm.transitions,
+            **(
+                {"devices": devices,
+                 "device_transitions": fm.device_transitions}
+                if devices else {}
+            ),
         }
         doc["cluster"]["stream_consumers"] = sorted(controller.stream_consumers)
     if rk is not None:
@@ -267,6 +324,7 @@ STATUS_SCHEMA: dict = {
         },
         "failure_monitor?": {
             "tracked": int, "failed": list, "transitions": int,
+            "devices?": dict, "device_transitions?": int,
         },
         "stream_consumers?": list,
     },
@@ -318,6 +376,14 @@ STATUS_SCHEMA: dict = {
         "resolve_ms_p50": (int, float),
         "resolve_ms_p99": (int, float),
         "per_resolver": list,
+        "device?": {
+            "states": dict,
+            "serving_cpu": int,
+            "trips": int,
+            "promotions": int,
+            "probes": int,
+            "time_degraded_s": (int, float),
+        },
     },
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
     "ratekeeper?": {

@@ -654,6 +654,13 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
     def capacity(self) -> int:
         return self._cap
 
+    def healthcheck(self) -> bool:
+        """One tiny host<->device round trip through every shard's count
+        lane: raises (classified by the DeviceSupervisor) when a mesh
+        device is gone or the stream is poisoned.  Forces a stream sync —
+        supervisor probes only, never the hot path."""
+        return int(np.asarray(self._dev_counts).sum()) >= 0
+
     def resolve_batch(self, commit_version: int, txns: Sequence[TxInfo]) -> list[Verdict]:
         self._drain_all()  # settle any deferred window before sync work
         validate_batch(commit_version, txns, self._oldest)

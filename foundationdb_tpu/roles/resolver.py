@@ -87,6 +87,20 @@ class Resolver:
         self.loop = loop
         self.knobs = knobs
         self.cs = conflict_set
+        if hasattr(conflict_set, "bind_clock"):
+            # a supervised device backend (conflict/supervisor.py) paces its
+            # retry backoff and re-probe schedule off OUR clock: virtual
+            # time under simulation (deterministic chaos), wall time when
+            # this role runs on the real network
+            conflict_set.bind_clock(loop.now)
+        if hasattr(conflict_set, "enable_wall_watchdog"):
+            from ..rpc.transport import RealProcess
+
+            if isinstance(process, RealProcess):
+                # real network: a hung PJRT call must be bounded by the
+                # wall-clock watchdog (under sim, threads are forbidden and
+                # hangs are injected virtually instead)
+                conflict_set.enable_wall_watchdog()
         self.version = NotifiedVersion(start_version)
         self.stream = RequestStream(process, self.WLT, unique=True)
         self.counters = CounterCollection("Resolver")

@@ -32,6 +32,14 @@ class FailureMonitor:
         self._status: dict = {}    # address -> FailureStatus
         self._override: dict = {}  # address -> bool (sim lies)
         self.transitions = 0
+        # accelerator-backend health, fed by each resolver's
+        # DeviceSupervisor (conflict/supervisor.py): name -> health dict
+        # (state/trips/time degraded...).  Kept apart from the process map —
+        # a degraded DEVICE is a performance event, not a dead process, and
+        # consumers must not reroute around a resolver whose CPU fallback
+        # is serving correctly.
+        self._devices: dict = {}
+        self.device_transitions = 0
 
     def set_status(self, address, failed: bool) -> None:
         """Feed an observation (heartbeat result).  Idempotent: `since`
@@ -55,6 +63,28 @@ class FailureMonitor:
             a for a in self._status.keys() | self._override.keys()
             if self.is_failed(a)
         ]
+
+    # -- device-backend health (conflict/supervisor.py feed) -----------------
+    def note_device(self, name: str, health: dict) -> None:
+        """Record a device supervisor's health snapshot; `since` semantics
+        match set_status — transitions counted on state changes only."""
+        prev = self._devices.get(name)
+        entry = dict(health)
+        if prev is None or prev.get("state") != entry.get("state"):
+            entry["since"] = self._clock()
+            self.device_transitions += 1
+        else:
+            entry["since"] = prev.get("since")
+        self._devices[name] = entry
+
+    def device_report(self) -> dict:
+        """name -> latest health snapshot (status.py rolls this up)."""
+        return {k: dict(v) for k, v in self._devices.items()}
+
+    def degraded_devices(self) -> list[str]:
+        return sorted(
+            k for k, v in self._devices.items() if v.get("state") == "degraded"
+        )
 
     # -- simulation hook -----------------------------------------------------
     def set_override(self, address, failed: bool | None) -> None:

@@ -42,6 +42,23 @@ from .stream import RpcError
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 << 20
+# every frame is a pickled (token, peer_addr, payload) tuple: even the
+# degenerate ("", None, None) pickles to 19 bytes, and real frames carry a
+# NetworkAddress (~100 bytes).  A declared length below this floor is a
+# corrupt/hostile header, rejected before any body reaches the deserializer.
+MIN_FRAME = 19
+
+
+class FrameError(ConnectionError):
+    """A length-corrupt or oversized frame header: the connection is severed
+    BEFORE the body reaches the deserializer — the first containment step
+    on the VERDICT 'wire uses pickle' weakness (a hostile peer must not get
+    to choose how many bytes we buffer, nor feed the decoder at all)."""
+
+    def __init__(self, reason: str, declared_len: int) -> None:
+        super().__init__(f"{reason} (declared {declared_len} bytes)")
+        self.reason = reason
+        self.declared_len = declared_len
 
 
 class TLSConfig:
@@ -91,13 +108,19 @@ class _Conn:
         self.out += _LEN.pack(len(blob)) + blob
 
     def frames(self):
-        """Yield complete frames out of inbuf."""
+        """Yield complete frames out of inbuf.  Header validation happens
+        as soon as the 4 length bytes arrive — an oversized or corrupt
+        declared length raises FrameError immediately, before any body
+        bytes are awaited (so a hostile header cannot make us buffer up to
+        4 GiB) and before anything reaches the deserializer."""
         pos = 0
         n = len(self.inbuf)
         while pos + _LEN.size <= n:
             (ln,) = _LEN.unpack_from(self.inbuf, pos)
             if ln > MAX_FRAME:
-                raise ConnectionError("oversized frame")
+                raise FrameError("oversized frame", ln)
+            if ln < MIN_FRAME:
+                raise FrameError("length-corrupt frame", ln)
             if pos + _LEN.size + ln > n:
                 break
             yield bytes(self.inbuf[pos + _LEN.size : pos + _LEN.size + ln])
@@ -130,9 +153,10 @@ class RealNetwork:
 
     def __init__(self, loop: EventLoop, name: str = "proc",
                  ip: str = "127.0.0.1", port: int = 0,
-                 tls: TLSConfig | None = None) -> None:
+                 tls: TLSConfig | None = None, trace=None) -> None:
         self.loop = loop
         self.tls = tls
+        self.trace = trace  # optional TraceCollector for wire-error events
         self._server_ctx = tls.server_ctx() if tls else None
         self._client_ctx = tls.client_ctx() if tls else None
         self._sel = selectors.DefaultSelector()
@@ -147,6 +171,19 @@ class RealNetwork:
         self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
         self.messages_sent = 0
         self.messages_dropped = 0
+        self.frames_rejected = 0   # length-corrupt/oversized headers severed
+        self.decode_failures = 0   # well-framed but undeserializable payloads
+
+    def _trace_wire_error(self, event_type: str, conn: "_Conn", **fields) -> None:
+        if self.trace is not None:
+            from ..runtime.trace import SEV_WARN
+
+            self.trace.trace(
+                event_type, severity=SEV_WARN,
+                track_latest=event_type,
+                Peer=str(conn.addr) if conn.addr else "unidentified",
+                **fields,
+            )
 
     # -- SimNetwork-compatible sending --------------------------------------
     def create_process(self, name: str) -> RealProcess:
@@ -338,8 +375,25 @@ class RealNetwork:
         conn.inbuf += data
         try:
             frames = list(conn.frames())
+        except FrameError as e:
+            # connection-level rejection: the declared length is hostile or
+            # corrupt, so nothing here may reach the deserializer — sever
+            # with a traced error (the reference severs on malformed
+            # ConnectPacket lengths the same way)
+            self.frames_rejected += 1
+            self._trace_wire_error(
+                "TransportFrameRejected", conn,
+                Reason=e.reason, DeclaredLen=e.declared_len,
+            )
+            self._drop_conn(conn)
+            return
+        try:
             decoded = [pickle.loads(b) for b in frames]
-        except Exception:  # noqa: BLE001 — corrupt peer: sever, don't die
+        except Exception as e:  # noqa: BLE001 — corrupt peer: sever, don't die
+            self.decode_failures += 1
+            self._trace_wire_error(
+                "TransportDecodeFailed", conn, Error=repr(e)[:200]
+            )
             self._drop_conn(conn)
             return
         for token, peer_addr, payload in decoded:

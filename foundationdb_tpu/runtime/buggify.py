@@ -11,6 +11,7 @@ from __future__ import annotations
 from .core import DeterministicRandom, TaskPriority
 
 _state: dict[str, bool] = {}
+_forced: dict[str, int] = {}
 _rng: DeterministicRandom | None = None
 _enable_prob = 0.25
 _fire_prob = 0.05
@@ -22,12 +23,23 @@ def enable(rng: DeterministicRandom, enable_prob: float = 0.25, fire_prob: float
     _enable_prob = enable_prob
     _fire_prob = fire_prob
     _state.clear()
+    _forced.clear()
 
 
 def disable() -> None:
     global _rng
     _rng = None
     _state.clear()
+    _forced.clear()
+
+
+def force(site: str, times: int = 1) -> None:
+    """Arm `site` to fire deterministically on its next `times` queries —
+    the campaign/test hook that makes a rare site's firing *required*
+    rather than probabilistic (the reference's per-SBVar forcing used by
+    targeted simulation tests).  Only honored in simulation (enable()d);
+    draws no randomness, so forcing never perturbs the seeded RNG stream."""
+    _forced[site] = _forced.get(site, 0) + times
 
 
 def is_enabled() -> bool:
@@ -47,6 +59,13 @@ def _buggify(site: str) -> bool:
     """True rarely, only in simulation.  `site` identifies the call site."""
     if _rng is None:
         return False
+    n = _forced.get(site, 0)
+    if n > 0:
+        if n == 1:
+            del _forced[site]
+        else:
+            _forced[site] = n - 1
+        return True
     if site not in _state:
         _state[site] = _rng.coinflip(_enable_prob)
     return _state[site] and _rng.coinflip(_fire_prob)

@@ -100,6 +100,22 @@ class CoreKnobs(Knobs):
         # published budget (reference SMOOTHING_AMOUNT, Knobs.cpp)
         self.init("RATEKEEPER_SMOOTHING_E", 1.0)
 
+        # device supervisor (conflict/supervisor.py): the DEFAULT_BACKOFF
+        # family applied to the hardware conflict backend.  Every device
+        # interaction is bounded by DEVICE_WATCHDOG_S (wall-clock watchdog
+        # on the real network; under sim the hang is injected virtually);
+        # failed attempts retry with exponential backoff
+        # (DEVICE_RETRY_BACKOFF doubling to DEVICE_MAX_BACKOFF), and after
+        # DEVICE_RETRY_LIMIT consecutive failures the circuit breaker trips
+        # to the CPU reference backend; re-probes then run every
+        # DEVICE_REPROBE_INTERVAL seconds until a parity-checked promotion
+        # succeeds (docs/OPERATIONS.md "Degraded device backend")
+        self.init("DEVICE_WATCHDOG_S", 30.0)
+        self.init("DEVICE_RETRY_LIMIT", 3)
+        self.init("DEVICE_RETRY_BACKOFF", 0.05 if r is None else 0.02 + r.random() * 0.1)
+        self.init("DEVICE_MAX_BACKOFF", 5.0)
+        self.init("DEVICE_REPROBE_INTERVAL", 5.0 if r is None else 1.0 + r.random() * 8.0)
+
         # data distribution (DataDistribution.actor.cpp): storage failure
         # ping cadence, shard-size poll cadence, and the split threshold
         # (the reference splits on byte size via StorageMetrics; we count keys)

@@ -97,6 +97,11 @@ def main(argv=None) -> None:
         trace_sink=sink,
         **extra,
     )
+    if rnet is not None:
+        # wire-level errors (rejected/undecodable frames) land in the
+        # cluster's trace stream; the collector only exists post-assembly,
+        # and the transport reads the attribute at event time
+        rnet.trace = cluster.trace
     db = cluster.database()
     if args.sample_rate > 0:
         db.debug_sample_rate = args.sample_rate

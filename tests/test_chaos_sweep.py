@@ -109,6 +109,159 @@ def test_chaos_sites_actually_fire():
     assert fired, "no buggify site ever armed across the sweep"
 
 
+class _RefereedConflictSet:
+    """Test-only wrapper running every batch through BOTH the supervised
+    device backend and a plain CPU oracle, recording any verdict mismatch —
+    the 'no verdict ever differs from the CPU oracle' referee for the
+    device-fault chaos sweep.  Mismatches are recorded, not raised, so a
+    bug surfaces as a clean assertion after the run instead of wedging the
+    resolver task mid-simulation."""
+
+    def __init__(self, inner, referee, mismatches):
+        self.inner = inner
+        self.referee = referee
+        self.mismatches = mismatches
+
+    def resolve_batch(self, version, txns):
+        got = self.inner.resolve_batch(version, txns)
+        want = self.referee.resolve_batch(version, txns)
+        if [int(v) for v in got] != [int(v) for v in want]:
+            self.mismatches.append((version, got, want))
+        return got
+
+    def resolve_deferred(self, version, txns):
+        handle = self.inner.resolve_deferred(version, txns)
+        want = self.referee.resolve_batch(version, txns)
+        outer = self
+
+        class _H:
+            def wait(self):
+                got = handle.wait()
+                if [int(v) for v in got] != [int(v) for v in want]:
+                    outer.mismatches.append((version, got, want))
+                return got
+
+        return _H()
+
+    def remove_before(self, version):
+        self.inner.remove_before(version)
+        self.referee.remove_before(version)
+
+    @property
+    def oldest_version(self):
+        return self.inner.oldest_version
+
+    @property
+    def node_count(self):
+        return self.inner.node_count
+
+    def kernel_stats(self):
+        return self.inner.kernel_stats()
+
+    def health(self):
+        return self.inner.health()
+
+    def bind_clock(self, clock):
+        self.inner.bind_clock(clock)
+
+    def bind_failmon(self, failmon, name=None):
+        self.inner.bind_failmon(failmon, name)
+
+    def healthcheck(self):
+        return self.inner.healthcheck()
+
+    def close(self):
+        self.inner.close()
+        self.referee.close()
+
+
+DEVICE_SITES = (
+    "device.lost",
+    "device.dispatch_hang",
+    "device.compile_fail",
+    "device.readback_corrupt",
+)
+
+
+def test_chaos_device_faults_mid_pipeline(monkeypatch):
+    """The device-fault campaign (ISSUE 4 acceptance): with each new
+    device.* buggify site tripped mid-run — in the split-phase pipeline
+    (FDBTPU_PIPELINE=1), so faults land inside an open deferred window —
+
+      (a) no verdict ever differs from the CPU oracle (referee wrapper),
+      (b) the workload completes exactly and every resolver ends healthy
+          or explicitly degraded — never wedged,
+      (c) cluster_status reports the device health roll-up,
+
+    and each site is *required* to have fired (runtime/coverage.py
+    discipline: fault injection that silently stops injecting fails here)."""
+    from foundationdb_tpu.conflict.device import DeviceConflictSet
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.conflict.supervisor import DeviceSupervisor
+    from foundationdb_tpu.control.status import cluster_status, validate_status
+    from foundationdb_tpu.runtime import coverage
+
+    monkeypatch.setenv("FDBTPU_PIPELINE", "1")
+    for i, site in enumerate(DEVICE_SITES):
+        mismatches: list = []
+
+        def make_cs(oldest=0, _m=mismatches):
+            return _RefereedConflictSet(
+                DeviceSupervisor(
+                    lambda o=0: DeviceConflictSet(o, capacity=1 << 10),
+                    oldest_version=oldest,
+                ),
+                OracleConflictSet(oldest),
+                _m,
+            )
+
+        c = RecoverableCluster(
+            seed=1500 + i, n_storage_shards=2, chaos=True,
+            conflict_backend=make_cs,
+        )
+
+        async def tripper(site=site):
+            # mid-run, mid-window: commits are flowing when the site fires.
+            # device.lost fires enough consecutive times to TRIP the
+            # breaker (DEVICE_RETRY_LIMIT), so the campaign provably walks
+            # the full degrade -> serve-degraded path, not just a retry.
+            await c.loop.delay(0.4)
+            buggify.force(site, 3 if site == "device.lost" else 2)
+
+        c.loop.spawn(tripper())
+        cyc = CycleWorkload(nodes=8, clients=2, txns_per_client=6)
+        metrics = run_workloads(c, [cyc], deadline=600.0)
+        assert metrics["Cycle"]["committed"] == 12, site
+        if not coverage.hits(f"buggify.{site}"):
+            # the workload outran the trip point: drive a few more commits
+            # so the armed fault meets live traffic (a forced site only
+            # fires when a device interaction actually happens)
+            db = c.database()
+
+            async def drive():
+                for j in range(4):
+                    tr = db.create_transaction()
+                    tr.set(b"post%d" % j, b"x")
+                    await tr.commit()
+
+            c.run_until(c.loop.spawn(drive()), 120.0)
+        assert mismatches == [], f"{site}: verdicts diverged from oracle"
+        assert coverage.hits(f"buggify.{site}") >= 1, f"{site} never fired"
+        for r in c.controller.generation.resolvers:
+            assert r.cs.health()["state"] in ("healthy", "degraded"), site
+        doc = cluster_status(c)
+        validate_status(doc)
+        assert "device" in doc["kernel"], site
+        c.stop()
+        buggify.disable()
+    # the campaign-level coverage contract: every device fault class was
+    # exercised AND at least one full breaker trip actually happened
+    for site in DEVICE_SITES:
+        assert coverage.hits(f"buggify.{site}") >= 1, site
+    assert coverage.hits("device.cpu_rebuild") >= 1
+    assert coverage.hits("device.degraded") >= 1, "no breaker trip all campaign"
+
+
 def test_sweep_covers_rare_paths():
     """The coveragetool discipline (flow/UnitTest.h TEST() + the reference's
     coveragetool): a chaos campaign must actually EXERCISE the rare paths

@@ -148,3 +148,99 @@ def test_dead_peer_fails_fast():
         drv.run_until(ref.get_reply("x", timeout=5.0), wall_timeout=10.0)
     assert time.monotonic() - t0 < 3.0, "refusal should beat the timeout"
     net.close()
+
+
+def _hostile_send(port: int, blob: bytes, *, also_valid_probe=None,
+                  wall_timeout: float = 10.0):
+    """Open a raw socket to a RealNetwork listener, send `blob` verbatim,
+    and pump the victim's reactor until it processes the bytes.  Returns
+    once the victim has either severed the connection or gone idle."""
+    import socket as _s
+    import time as _t
+
+    s = _s.socket()
+    s.connect(("127.0.0.1", port))
+    s.sendall(blob)
+    deadline = _t.monotonic() + wall_timeout
+    severed = False
+    s.settimeout(0.2)
+    while _t.monotonic() < deadline:
+        also_valid_probe(0.05)
+        try:
+            if s.recv(1 << 12) == b"":
+                severed = True
+                break
+        except _s.timeout:
+            continue
+        except OSError:
+            severed = True
+            break
+    s.close()
+    return severed
+
+
+@pytest.mark.parametrize("header,reason", [
+    (0xFFFFFFFF, "oversized frame"),   # 4 GiB declared: hostile buffering
+    ((64 << 20) + 1, "oversized frame"),
+    (0, "length-corrupt frame"),       # zero-length: corrupt header
+    (1, "length-corrupt frame"),
+])
+def test_corrupt_frame_rejected_at_connection_level(header, reason):
+    """An oversized or length-corrupt frame header must sever the
+    connection with a traced error BEFORE any bytes reach the pickle
+    deserializer — and without buffering the declared body."""
+    import struct as _struct
+
+    from foundationdb_tpu.runtime.trace import TraceCollector
+
+    loop = EventLoop()
+    trace = TraceCollector(loop.now)
+    victim = RealNetwork(loop, name="victim", trace=trace)
+    blob = _struct.pack("<I", header) + b"\x00" * 64  # header + partial junk
+    severed = _hostile_send(victim.address.port, blob,
+                            also_valid_probe=victim.pump)
+    assert severed, "victim kept the hostile connection open"
+    assert victim.frames_rejected == 1
+    assert victim.decode_failures == 0
+    evs = trace.find("TransportFrameRejected")
+    assert len(evs) == 1 and evs[0]["Reason"] == reason
+    assert evs[0]["DeclaredLen"] == header
+    victim.close()
+
+
+def test_undeserializable_frame_severs_with_decode_error():
+    """A well-framed but unpicklable payload is the deserializer-level
+    failure: severed too, but counted/traced as a decode failure."""
+    import struct as _struct
+
+    from foundationdb_tpu.runtime.trace import TraceCollector
+
+    loop = EventLoop()
+    trace = TraceCollector(loop.now)
+    victim = RealNetwork(loop, name="victim", trace=trace)
+    body = b"\x95garbage-not-pickle"
+    blob = _struct.pack("<I", len(body)) + body
+    severed = _hostile_send(victim.address.port, blob,
+                            also_valid_probe=victim.pump)
+    assert severed
+    assert victim.frames_rejected == 0
+    assert victim.decode_failures == 1
+    assert len(trace.find("TransportDecodeFailed")) == 1
+    victim.close()
+
+
+def test_valid_traffic_unaffected_by_frame_guards(server):
+    """Regression guard: the MIN/MAX frame validation must not reject real
+    frames (the smallest legitimate payloads ride well above MIN_FRAME)."""
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client")
+    drv = NetDriver(loop, net)
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", server), "wlt:echo")
+    )
+    out = drv.run_until(ref.get_reply(None, timeout=5.0), wall_timeout=10.0)
+    assert out == ("echoed", None)
+    assert net.frames_rejected == 0 and net.decode_failures == 0
+    net.close()
