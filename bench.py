@@ -241,78 +241,123 @@ def _probe_cache_write(ok: bool, detail: str) -> None:
         print(f"[bench] probe cache write failed: {e!r}", file=sys.stderr)
 
 
-def _init_backend(timeout_s: float | None = None) -> dict:
+def _probe_budgets(cache: dict | None, env=None) -> list[float]:
+    """Per-attempt probe budgets — a PURE function so the total probe
+    bound is testable (tests/test_bench_probe.py pins it).
+
+    Every budget is CLAMPED to the supervisor's watchdog knob
+    (DEVICE_WATCHDOG_S): BENCH_INIT_TIMEOUT may only lower it.  BENCH_r05
+    recorded two consecutive ~180 s probe "hangs" despite PR 4's
+    documented <60 s worst case — driver-supplied env overrides must
+    never be able to reopen that hole.  A cached failure keeps exactly
+    ONE short attempt."""
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+    env = os.environ if env is None else env
+    watchdog = CoreKnobs().DEVICE_WATCHDOG_S
+    try:
+        retry_s = float(env.get("BENCH_INIT_TIMEOUT", str(watchdog)))
+    except ValueError:
+        retry_s = watchdog
+    retry_s = min(retry_s, watchdog)
+    try:
+        fast_s = float(env.get("BENCH_PROBE_FAST_S", "20"))
+    except ValueError:
+        fast_s = 20.0
+    fast_s = min(fast_s, retry_s)
+    if cache is not None and not cache.get("ok", False):
+        return [fast_s]
+    return [fast_s, retry_s]
+
+
+def _run_probe(budget: float) -> tuple[bool, bool, int | None, str]:
+    """One probe attempt in its own PROCESS GROUP, hard-bounded by
+    `budget` wall seconds.  Returns (ok, timed_out, rc, detail).
+
+    The BENCH_r05 regression: `subprocess.run(capture_output=True,
+    timeout=...)` kills only the direct child on timeout, then BLOCKS
+    reading its pipes until every grandchild holding them exits — a wedged
+    PJRT helper turned a 20 s budget into the driver's 180 s bound, twice.
+    Killing the whole process group closes the pipes inside the budget."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,  # own group: killpg reaps grandchildren too
+    )
+    try:
+        out, err = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, AttributeError):
+            proc.kill()
+        try:  # group is dead: the pipes close promptly
+            proc.communicate(timeout=5)
+        except Exception:  # noqa: BLE001 — abandon the fds, never block
+            for f in (proc.stdout, proc.stderr):
+                if f is not None:
+                    f.close()
+        return False, True, None, f"probe hung > {budget}s (killed by watchdog)"
+    rc = proc.returncode
+    ok = rc == 0 and "PROBE_OK" in out
+    text = (out + err).strip()
+    detail = text.splitlines()[-1][:300] if text else f"rc={rc}"
+    return ok, False, rc, detail
+
+
+def _init_backend() -> dict:
     """Initialize the JAX backend defensively.
 
     The axon TPU tunnel in this environment can hang for minutes or die
     with Unavailable; a bench that crashes before printing ANY number is
     worthless (round-1 lesson: BENCH_r01 was rc=1 with no output), and a
-    bench that burns 2x180 s of probe timeout on EVERY run while the tunnel
-    is down wastes most of the round budget re-measuring a known-dead link
-    (round-4/5 lesson: BENCH_r04/r05).  So:
+    bench that burns minutes of probe timeout on EVERY run while the
+    tunnel is down wastes most of the round budget re-measuring a
+    known-dead link (round-4/5/6 lesson: BENCH_r04/r05).  So:
 
-      * the last probe outcome is cached in .bench_state/probe.json;
+      * the last probe outcome is cached in .bench_state/probe.json, and
+        the failure cache is written after EVERY failed attempt — a run
+        the driver kills mid-probe still fast-fails the next run;
       * the first probe is SHORT (~20 s — a live tunnel answers the 64-int
         round trip well inside that);
-      * exactly one retry follows, bounded by the supervisor's watchdog
-        knob (DEVICE_WATCHDOG_S, default 30 s; BENCH_INIT_TIMEOUT
-        overrides), and only when the cache does NOT already say the
-        tunnel was down last run (a cached failure fast-fails the run at
-        one short probe; no cache or a cached success earns the benefit of
-        the doubt);
+      * at most one retry follows, clamped to the supervisor's watchdog
+        knob (DEVICE_WATCHDOG_S; BENCH_INIT_TIMEOUT may only lower it —
+        _probe_budgets), skipped entirely when the cache already says the
+        tunnel was down OR the first attempt classified as a hang (a
+        tunnel that ignored 20 s does not answer a 30 s retry);
+      * probes run in their own PROCESS GROUP and are group-killed on
+        timeout (_run_probe) — a wedged PJRT grandchild holding our pipes
+        can no longer stretch a 20 s budget to the driver's bound;
       * every attempt's outcome is CLASSIFIED (hang / no_device /
         compile_fail / lost — conflict/supervisor.py classify_failure) and
         appended to .bench_state/probe.log, so a dead round leaves a
         forensic trail instead of a bare rc=124.
 
-    Worst-case probing is ~20 + 30 s < 60 s, after which main() emits the
-    native-CPU metric line (already measured before probing started).
-    A hung in-process PJRT init cannot be retried — the C++ layer holds
-    global state — so probes run in a SUBPROCESS that a timeout can kill;
-    only after one succeeds does the in-process init run (on a daemon
-    thread with a timeout, in case the tunnel dies in the gap)."""
-    import subprocess
+    Worst-case probing is ~20 + 30 s < 60 s (test-pinned), after which
+    main() emits the native-CPU metric line (already measured before
+    probing started).  A hung in-process PJRT init cannot be retried — the
+    C++ layer holds global state — so probes run in a SUBPROCESS; only
+    after one succeeds does the in-process init run (on a daemon thread
+    with a timeout, in case the tunnel dies in the gap)."""
     import threading
     import traceback
 
-    # the probe watchdog shares the supervisor's knob (DEVICE_WATCHDOG_S,
-    # default 30 s): the probe must fail FAST and classified, never hang
-    # the 180 s the pre-supervisor rounds recorded in probe.log
-    if timeout_s is None:
-        from foundationdb_tpu.runtime.knobs import CoreKnobs
-
-        timeout_s = CoreKnobs().DEVICE_WATCHDOG_S
-    retry_s = float(os.environ.get("BENCH_INIT_TIMEOUT", str(timeout_s)))
-    fast_s = min(
-        float(os.environ.get("BENCH_PROBE_FAST_S", "20")), retry_s
-    )
     cache = _probe_cache_read()
-    budgets = [fast_s]
-    if cache is None or cache.get("ok", False):
-        budgets.append(retry_s)
-    else:
+    budgets = _probe_budgets(cache)
+    if len(budgets) == 1:
         print(
             f"[bench] probe cache: tunnel was down last run "
-            f"({cache.get('detail', '?')}); one short probe only",
+            f"({(cache or {}).get('detail', '?')}); one short probe only",
             file=sys.stderr,
         )
 
     result: dict = {}
     for attempt, budget in enumerate(budgets):
         t0 = time.perf_counter()
-        timed_out, rc = False, None
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=budget,
-            )
-            rc = proc.returncode
-            ok = proc.returncode == 0 and "PROBE_OK" in proc.stdout
-            text = (proc.stdout + proc.stderr).strip()
-            detail = text.splitlines()[-1][:300] if text else f"rc={rc}"
-        except subprocess.TimeoutExpired:
-            ok, timed_out = False, True
-            text = detail = f"probe hung > {budget}s (killed by watchdog)"
+        ok, timed_out, rc, detail = _run_probe(budget)
         dt = time.perf_counter() - t0
         if ok:
             print(f"[bench] probe OK in {dt:.1f}s: {detail}", file=sys.stderr)
@@ -325,14 +370,20 @@ def _init_backend(timeout_s: float | None = None) -> dict:
         cls = _classify_probe(timed_out, rc, detail)
         result["error"] = f"[{cls}] {detail}"
         result["failure_class"] = cls
+        # cache the failure NOW: a driver-killed run must not cost the next
+        # run a full budget re-discovering a dead tunnel
+        _probe_cache_write(False, result["error"])
         _probe_log(cls, detail, attempt + 1, len(budgets), budget, dt)
         print(
             f"[bench] probe attempt {attempt + 1}/{len(budgets)} failed "
             f"after {dt:.1f}s [{cls}]: {detail}",
             file=sys.stderr,
         )
-    else:
-        _probe_cache_write(False, result.get("error", "?"))
+        if cls == "hang":
+            # a hung tunnel ignored this whole budget; the retry would
+            # spend DEVICE_WATCHDOG_S more learning nothing
+            break
+    if not ok:
         return result
 
     # tunnel answers: init in-process (still guarded — it can die in the gap)
@@ -372,7 +423,7 @@ def _init_backend(timeout_s: float | None = None) -> dict:
 
 
 def _emit(metric: str, value: float, vs_baseline: float, error: str | None = None,
-          kernel: dict | None = None) -> None:
+          kernel: dict | None = None, commit_wire: dict | None = None) -> None:
     doc = {
         "metric": metric,
         "value": round(value, 1),
@@ -386,7 +437,144 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
         # trajectory future rounds regress against — padding occupancy,
         # bucket-induced recompiles, per-batch resolve-time percentiles
         doc["kernel"] = kernel
+    if commit_wire is not None:
+        # commit-plane wire trajectory (docs/WIRE.md): codec encode/decode
+        # wall + bytes for a bench-class resolver batch and TLog push,
+        # speedup vs protocol-4 pickle, and the transport coalescing factor
+        doc["commit_wire"] = commit_wire
     print(json.dumps(doc))
+
+
+def _commit_wire_probe(n_txns: int = 4096, reps: int = 5) -> dict | None:
+    """Measure the commit-plane wire path at bench shapes (docs/WIRE.md):
+
+      * codec encode/decode of ONE bench-class ResolveTransactionBatchRequest
+        (n_txns txns × 2 point reads + 1 point write, 16-byte keys) and the
+        matching TLogCommitRequest, best-of-`reps`, vs protocol-4 pickle;
+      * a real loopback-TCP burst through two RealNetworks to read the
+        transport's frames-per-flush coalescing factor.
+
+    Pure CPU + loopback sockets — safe on device and no-device runs alike."""
+    import pickle
+
+    from foundationdb_tpu.conflict.api import TxInfo
+    from foundationdb_tpu.roles.types import (
+        Mutation,
+        MutationType,
+        ResolveTransactionBatchRequest,
+        TLogCommitRequest,
+    )
+    from foundationdb_tpu.runtime.metrics import WireStats
+    from foundationdb_tpu.runtime.serialize import decode_payload, encode_payload
+
+    rng = np.random.default_rng(SEED + 7)
+    pool = rng.integers(0, 256, size=(1 << 14, KEY_BYTES), dtype=np.uint8)
+    keys = [bytes(pool[i]) for i in range(pool.shape[0])]
+    idx = rng.integers(0, len(keys), size=(n_txns, 3))
+    req = ResolveTransactionBatchRequest(9, 10, [
+        TxInfo(
+            5,
+            [(keys[i], keys[i] + b"\x00"), (keys[j], keys[j] + b"\x00")],
+            [(keys[k], keys[k] + b"\x00")],
+        )
+        for i, j, k in idx
+    ])
+    push = TLogCommitRequest(9, 10, {
+        f"ss-{t}": [
+            Mutation(MutationType.SET_VALUE, keys[i], b"v" * 16)
+            for i in rng.integers(0, len(keys), size=n_txns // 4)
+        ]
+        for t in range(4)
+    }, known_committed=8)
+
+    def best(f):
+        out = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            out.append(time.perf_counter() - t0)
+        return min(out)
+
+    try:
+        stats = WireStats()
+        blobs = [(m, encode_payload(m, stats=stats)) for m in (req, push)]
+        out = {"pickle_fallbacks": stats.pickle_fallbacks}
+        enc_s = dec_s = pk_enc_s = pk_dec_s = 0.0
+        nbytes = pk_bytes = 0
+        for msg, blob in blobs:
+            pk = pickle.dumps(msg, protocol=4)
+            enc_s += best(lambda m=msg: encode_payload(m))
+            dec_s += best(lambda b=blob: decode_payload(b))
+            pk_enc_s += best(lambda m=msg: pickle.dumps(m, protocol=4))
+            pk_dec_s += best(lambda b=pk: pickle.loads(b))
+            nbytes += len(blob)
+            pk_bytes += len(pk)
+        out.update(
+            encode_ms=round(enc_s * 1e3, 3),
+            decode_ms=round(dec_s * 1e3, 3),
+            bytes=nbytes,
+            pickle_bytes=pk_bytes,
+            vs_pickle_encode=round(pk_enc_s / enc_s, 2) if enc_s else 0.0,
+            vs_pickle_decode=round(pk_dec_s / dec_s, 2) if dec_s else 0.0,
+            txns=n_txns,
+        )
+        out.update(_wire_flush_probe() or {})
+        return out
+    except Exception as e:  # noqa: BLE001 — the wire probe is additive data
+        print(f"[bench] commit_wire probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _wire_flush_probe(n_frames: int = 64) -> dict | None:
+    """Send a burst of small resolver batches across two in-process
+    RealNetworks (real loopback TCP) and report the sender's coalescing
+    factor — frames per flushed write."""
+    from foundationdb_tpu.conflict.api import TxInfo
+    from foundationdb_tpu.roles.types import ResolveTransactionBatchRequest
+    from foundationdb_tpu.rpc.stream import RequestStream, RequestStreamRef
+    from foundationdb_tpu.rpc.transport import RealNetwork
+    from foundationdb_tpu.runtime.core import EventLoop
+
+    loop = EventLoop()
+    a = RealNetwork(loop, name="bench-a")
+    b = RealNetwork(loop, name="bench-b")
+    try:
+        rs = RequestStream(b.process, "wlt:sink")
+        got = []
+
+        async def sink():
+            while True:
+                got.append(await rs.next())
+
+        loop.spawn(sink())
+        ref = RequestStreamRef(a, a.process, rs.endpoint)
+        msg = ResolveTransactionBatchRequest(
+            1, 2, [TxInfo(1, [(b"k%04d" % i, b"k%04d\x00" % i)], []) for i in range(32)]
+        )
+        for _ in range(n_frames):
+            ref.send(msg)  # one-way: the burst queues before any flush
+
+        async def waiter():
+            while len(got) < n_frames:
+                await loop.delay(0.001)
+
+        from foundationdb_tpu.rpc.transport import WallDriver
+        from foundationdb_tpu.runtime.core import TimedOut
+
+        try:
+            WallDriver(loop, [a.pump, b.pump]).run_until(
+                loop.spawn(waiter()), wall_timeout=10.0
+            )
+        except TimedOut:
+            return None
+        snap = a.wire.snapshot()
+        return {
+            "frames_per_flush": round(snap["frames_per_flush"], 1),
+            "flushes": snap["flushes"],
+        }
+    finally:
+        a.close()
+        b.close()
 
 
 def _resolver_e2e(n_batches: int, n_txns: int, cap: int, *, stage=None,
@@ -487,6 +675,7 @@ def _cpu_phase_main() -> None:
         "pad_ms": round(e2e["pad_ms"], 2),
         "h2d_ms": round(e2e["h2d_ms"], 2),
         "resolver_e2e_checks_per_sec": round(e2e_rate, 1),
+        "commit_wire": _commit_wire_probe(),
     }))
 
 
@@ -561,12 +750,18 @@ def main() -> None:
         # small JAX-CPU pass in a subprocess (the wedged-PJRT state of THIS
         # process cannot be trusted to run jax).
         print(f"[bench] NO DEVICE BACKEND: {init.get('error')}", file=sys.stderr)
+        kern = _cpu_phase_probe()
+        # the cpu-phase subprocess already measured the wire probe under a
+        # clean JAX-CPU env; lift it to the top-level block (measure
+        # in-process only if that pass failed)
+        wire = (kern or {}).pop("commit_wire", None) or _commit_wire_probe()
         _emit(
             "occ_conflict_checks_per_sec_native_cpu_64k_live_ranges",
             native_rate,
             0.0,
             error=f"device backend unavailable: {init.get('error', '?')[:500]}",
-            kernel=_cpu_phase_probe(),
+            kernel=kern,
+            commit_wire=wire,
         )
         os._exit(0)  # daemon init thread may be wedged in PJRT; exit hard
     backend = init["backend"]
@@ -831,6 +1026,7 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
         total_checks / device_s,
         native_s / device_s,
         kernel=kernel,
+        commit_wire=_commit_wire_probe(),
     )
 
 

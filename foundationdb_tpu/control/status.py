@@ -234,6 +234,21 @@ def cluster_status(cluster) -> dict[str, Any]:
     # -- conflict-kernel profiling counters (tentpole seam 2) ---------------
     doc["kernel"] = _kernel_rollup(resolvers)
 
+    # -- commit-plane wire counters (docs/WIRE.md) --------------------------
+    # codec bytes/wall, frames per coalesced flush, and the pickle-fallback
+    # census (by type: a hot message regressing off its codec shows up here
+    # by NAME).  SimNetwork and RealNetwork expose the same WireStats shape;
+    # the cluster fabric is the sim one, so the coalescing counters live in
+    # the REAL transport's snapshot — merged under `transport` when the
+    # server runs a wall-clock TCP fabric alongside (tools/server.py).
+    wire = getattr(cluster.net, "wire", None)
+    if wire is not None:
+        doc["commit_wire"] = snap = wire.snapshot()
+        rnet = getattr(getattr(cluster, "_wall_driver", None), "net", None)
+        rwire = getattr(rnet, "wire", None)
+        if rwire is not None:
+            snap["transport"] = rwire.snapshot()
+
     rk = getattr(cluster, "ratekeeper", None)
     doc["cluster"]["messages"] = _messages(trace, rk) + _device_messages(resolvers)
 
@@ -384,6 +399,24 @@ STATUS_SCHEMA: dict = {
             "probes": int,
             "time_degraded_s": (int, float),
         },
+    },
+    "commit_wire?": {
+        "frames_encoded": int,
+        "frames_decoded": int,
+        "bytes_encoded": int,
+        "bytes_decoded": int,
+        "encode_ms": (int, float),
+        "decode_ms": (int, float),
+        "pickle_fallbacks": int,
+        "fallback_types": dict,
+        "decode_fallbacks": int,
+        "flushes": int,
+        "frames_flushed": int,
+        "frames_per_flush": (int, float),
+        # the wall-clock TCP fabric's WireStats (same shape), present when
+        # the server runs one alongside the sim fabric (tools/server.py) —
+        # its flushes/frames_per_flush are where coalescing actually shows
+        "transport?": dict,
     },
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
     "ratekeeper?": {

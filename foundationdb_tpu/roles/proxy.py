@@ -55,7 +55,13 @@ from ..runtime.coverage import testcov
 class KeyPartitionMap:
     """Contiguous key partitions → members (resolver index or storage tag).
     The static stand-in for the reference's keyResolvers / keyServers
-    KeyRangeMaps (coalesced range maps on the proxy)."""
+    KeyRangeMaps (coalesced range maps on the proxy).
+
+    Routing is bisect-based: `split_ranges` finds the touched partition
+    SPAN of each range with two binary searches and clips only at the span
+    edges — the commit path's phase-2/phase-4 workhorse.  The old
+    per-partition `clip_to_member` probe is kept as the referee oracle
+    (tests/test_rangemap.py asserts the two agree on randomized maps)."""
 
     def __init__(self, split_keys: list[bytes], members: list) -> None:
         if len(members) != len(split_keys) + 1:
@@ -63,14 +69,26 @@ class KeyPartitionMap:
         self.splits = list(split_keys)
         self.members = list(members)
 
+    def position_for_key(self, key: bytes) -> int:
+        """Partition INDEX holding `key` (== member index for resolver
+        maps; for storage maps the member at this index is a team)."""
+        return bisect.bisect_right(self.splits, key)
+
     def member_for_key(self, key: bytes):
         return self.members[bisect.bisect_right(self.splits, key)]
 
-    def members_for_range(self, begin: bytes, end: bytes) -> list:
+    def span_for_range(self, begin: bytes, end: bytes) -> tuple[int, int]:
+        """(lo, hi) inclusive partition-index span intersecting
+        [begin, end); (0, -1) for an empty range."""
         if begin >= end:
-            return []
-        lo = bisect.bisect_right(self.splits, begin)
-        hi = bisect.bisect_left(self.splits, end)
+            return 0, -1
+        return (
+            bisect.bisect_right(self.splits, begin),
+            bisect.bisect_left(self.splits, end),
+        )
+
+    def members_for_range(self, begin: bytes, end: bytes) -> list:
+        lo, hi = self.span_for_range(begin, end)
         return self.members[lo : hi + 1]
 
     def clip_to_member(self, idx: int, begin: bytes, end: bytes) -> tuple[bytes, bytes] | None:
@@ -79,6 +97,44 @@ class KeyPartitionMap:
         b = max(begin, lo)
         e = end if hi is None else min(end, hi)
         return (b, e) if b < e else None
+
+    def split_ranges(
+        self, ranges
+    ) -> "dict[int, list[tuple[bytes, bytes]]]":
+        """Partition index -> clipped pieces of `ranges`, touched
+        partitions only.  One bisect span per range instead of one clip
+        probe per (range, partition): the O(ranges × partitions) loop the
+        commit path used to run collapses to O(ranges · log splits +
+        touched).  Piece order per partition follows input range order,
+        and pieces are byte-identical to `clip_to_member`'s output:
+          * lo = bisect_right(splits, begin) ⇒ splits[lo-1] <= begin <
+            splits[lo], so the first piece keeps `begin` uncut
+          * hi = bisect_left(splits, end) ⇒ splits[hi-1] < end <=
+            splits[hi], so the last piece keeps `end` uncut (and a range
+            beginning ON a split key routes right, like member_for_key)
+          * interior partitions take their full [splits[r-1], splits[r])
+        """
+        splits = self.splits
+        out: dict[int, list[tuple[bytes, bytes]]] = {}
+        br = bisect.bisect_right
+        bl = bisect.bisect_left
+        for b, e in ranges:
+            if b >= e:
+                continue
+            lo = br(splits, b)
+            hi = bl(splits, e)
+            if lo == hi:  # one partition holds the whole range
+                piece = out.get(lo)
+                if piece is None:
+                    out[lo] = [(b, e)]
+                else:
+                    piece.append((b, e))
+                continue
+            out.setdefault(lo, []).append((b, splits[lo]))
+            for r in range(lo + 1, hi):
+                out.setdefault(r, []).append((splits[r - 1], splits[r]))
+            out.setdefault(hi, []).append((splits[hi - 1], e))
+        return out
 
 
 @dataclasses.dataclass
@@ -376,29 +432,38 @@ class CommitProxy:
                 testcov("proxy.bad_versionstamp_prereresolve")
 
         # phase 2: per-resolver range split (ResolutionRequestBuilder :242)
-        # using the partition map effective at THIS batch's version
+        # using the partition map effective at THIS batch's version.
+        # Bisect routing: each conflict range finds its touched resolver
+        # SPAN with two binary searches (KeyPartitionMap.split_ranges)
+        # instead of every resolver clip-probing every range — the old
+        # O(txns × resolvers × ranges) pure-Python loop on the hottest
+        # path in the system.  Untouched resolvers still receive a
+        # (shared) empty TxInfo so reply verdicts stay index-aligned for
+        # the phase-3 min-combine.
         t_res = self.loop.now()
         rmap = self.rmap_at(version)
         n_res = len(self.resolvers)
         per_res: list[list[TxInfo]] = [[] for _ in range(n_res)]
         for i, pc in enumerate(batch):
             t = pc.request
+            snap = t.read_snapshot
             if bad_stamp[i]:
+                empty = TxInfo(snap, [], [])
                 for r in range(n_res):
-                    per_res[r].append(TxInfo(t.read_snapshot, [], []))
+                    per_res[r].append(empty)
                 continue
+            rr_by = rmap.split_ranges(t.read_conflict_ranges)
+            wr_by = rmap.split_ranges(t.write_conflict_ranges)
+            empty = None
             for r in range(n_res):
-                rr = [
-                    c
-                    for b, e in t.read_conflict_ranges
-                    if (c := rmap.clip_to_member(r, b, e))
-                ]
-                wr = [
-                    c
-                    for b, e in t.write_conflict_ranges
-                    if (c := rmap.clip_to_member(r, b, e))
-                ]
-                per_res[r].append(TxInfo(t.read_snapshot, rr, wr))
+                rr = rr_by.get(r)
+                wr = wr_by.get(r)
+                if rr is None and wr is None:
+                    if empty is None:
+                        empty = TxInfo(snap, [], [])
+                    per_res[r].append(empty)
+                else:
+                    per_res[r].append(TxInfo(snap, rr or [], wr or []))
         replies = await wait_all(
             [
                 self.loop.spawn(
@@ -478,18 +543,23 @@ class CommitProxy:
                     verdicts[ti] = Verdict.CONFLICT
                     continue
             txn_order += 1
+            tmap = self.tags
+            tmembers = tmap.members
+            seg_bytes = self.seg_write_bytes
             for m in muts:
                 nb = len(m.key) + len(m.value or b"")
                 if m.type == MutationType.CLEAR_RANGE:
-                    teams = self.tags.members_for_range(m.key, m.value)
-                    lo = bisect.bisect_right(self.tags.splits, m.key)
-                    for s in range(lo, lo + len(teams)):
-                        self.seg_write_bytes[s] += nb
+                    # one bisect span instead of members_for_range + a
+                    # second bisect for the byte accounting (phase-2's
+                    # routing treatment applied to tag routing)
+                    lo, hi = tmap.span_for_range(m.key, m.value)
+                    teams = tmembers[lo : hi + 1]
+                    for s in range(lo, hi + 1):
+                        seg_bytes[s] += nb
                 else:
-                    teams = [self.tags.member_for_key(m.key)]
-                    self.seg_write_bytes[
-                        bisect.bisect_right(self.tags.splits, m.key)
-                    ] += nb
+                    s = tmap.position_for_key(m.key)
+                    teams = [tmembers[s]]
+                    seg_bytes[s] += nb
                 # a member is a storage TEAM: every replica has its own tag
                 # and receives every mutation of its shard (the reference
                 # tags each mutation with the whole team's server tags)

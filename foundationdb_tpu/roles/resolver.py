@@ -263,12 +263,19 @@ class Resolver:
     # -- resolutionBalancing support ----------------------------------------
     def _sample_load(self, txns) -> None:
         for tx in txns:
-            ranges = list(tx.read_ranges) + list(tx.write_ranges)
-            self._load_ranges += len(ranges)
-            for b, _e in ranges:
-                self._sample_i += 1
-                if self._sample_i % 8 == 0:
-                    self._samples.append(b)
+            rr = tx.read_ranges
+            wr = tx.write_ranges
+            if not rr and not wr:
+                # bisect routing sends this resolver an empty TxInfo for
+                # every txn it doesn't touch (index alignment) — skip them
+                # without building throwaway lists
+                continue
+            self._load_ranges += len(rr) + len(wr)
+            for ranges in (rr, wr):
+                for b, _e in ranges:
+                    self._sample_i += 1
+                    if self._sample_i % 8 == 0:
+                        self._samples.append(b)
         if len(self._samples) > 256:
             self._samples = self._samples[::2]  # deterministic decimation
 

@@ -31,6 +31,10 @@ from .types import (
     TLogPeekRequest,
     TLogPopRequest,
     Version,
+    _dec_tag_map,
+    _dec_tagged_entries,
+    _enc_tag_map,
+    _enc_tagged_entries,
 )
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
@@ -43,27 +47,38 @@ from ..runtime.serialize import (
     decode_version_mutations,
     encode_version_mutations,
     read_mutation,
-    write_mutation,
 )
 
-# durable-log record types
-_R_RESET, _R_COMMIT, _R_POP = 0, 1, 2
+# durable-log record types.  _R_RESET is the LEGACY (pre-wire-overhaul)
+# per-mutation BinaryWriter framing, still decoded so a disk queue written
+# by an older build recovers cleanly; new RESETs write _R_RESET2.
+_R_RESET, _R_COMMIT, _R_POP, _R_RESET2 = 0, 1, 2, 3
 
 
 def _encode_reset(start_version: Version, known_committed: Version,
                   tags: dict[str, list]) -> bytes:
-    w = BinaryWriter().u8(_R_RESET).i64(start_version).i64(known_committed)
-    w.u32(len(tags))
-    for tag, entries in tags.items():
-        w.str_(tag).u32(len(entries))
-        for v, muts in entries:
-            w.i64(v).u32(len(muts))
-            for m in muts:
-                write_mutation(w, m)
-    return w.data()
+    """Generation-start snapshot record (_R_RESET2).  The per-tag entry
+    framing is the SAME struct-of-arrays codec the wire's TLogLockReply /
+    TLogPeekReply use (roles/types.py `_enc_tag_map`): one length array +
+    one joined blob per mutation list, so re-framing a large handed-over
+    state at recovery costs list appends, not a BinaryWriter call per
+    mutation — and the disk and wire formats for tag state cannot drift."""
+    w = BinaryWriter().u8(_R_RESET2).i64(start_version).i64(known_committed)
+    parts: list[bytes] = [w.data()]
+    _enc_tag_map(tags, parts, _enc_tagged_entries)
+    return b"".join(parts)
 
 
-def _decode_reset(r: BinaryReader):
+def _decode_reset2(r: BinaryReader):
+    start, kc = r.i64(), r.i64()
+    buf = r.rest()
+    tags, _pos = _dec_tag_map(buf, 0, _dec_tagged_entries)
+    return start, kc, tags
+
+
+def _decode_reset_legacy(r: BinaryReader):
+    """The pre-overhaul _R_RESET layout (BinaryWriter per-mutation framing):
+    kept so logs written by an older build still recover."""
     start, kc = r.i64(), r.i64()
     tags: dict[str, list] = {}
     for _ in range(r.u32()):
@@ -379,8 +394,10 @@ class TLog:
         for rec in dq.recover():
             r = BinaryReader(rec)
             t = r.u8()
-            if t == _R_RESET:
-                end, kc, tags = _decode_reset(r)
+            if t == _R_RESET2:
+                end, kc, tags = _decode_reset2(r)
+            elif t == _R_RESET:
+                end, kc, tags = _decode_reset_legacy(r)
             elif t == _R_COMMIT:
                 rec_kc = r.i64()
                 version, by_tag = decode_version_mutations(r.rest())

@@ -392,3 +392,536 @@ class DatabaseLocked(Exception):
     """The database is locked (ManagementAPI lock/unlock) and this
     transaction is neither lock-aware nor a system (`\\xff`) write —
     reference error 1038 (fdbclient error_definitions.h)."""
+
+
+# ===========================================================================
+# Wire codecs (runtime/serialize.py registry) — the commit-plane messages'
+# binary formats.  Registered at import of this module, so any process that
+# can CONSTRUCT these messages also encodes them binary; a process that
+# merely decodes reaches here through the registry's lazy import.
+#
+# Codec rules (docs/WIRE.md):
+#   * hot batch messages (resolver batch, TLog push) use a struct-of-arrays
+#     layout — counts, then one length array, then one joined key blob — so
+#     per-element Python work is list appends (measured ~2x faster than
+#     protocol-4 pickle at bench shapes; tests/test_codecs.py pins it)
+#   * every decode validates lengths against the buffer; corruption raises
+#     (CodecError at the registry boundary) and the transport severs the
+#     connection, exactly like an oversized pickle frame
+#   * decode must reproduce pickle-equal objects (tests/test_codecs.py
+#     fuzzes every registered type against that invariant)
+# ===========================================================================
+
+import struct as _struct  # noqa: E402
+
+from ..conflict.api import TxInfo  # noqa: E402
+from ..runtime import serialize as _wire  # noqa: E402
+from ..runtime.serialize import CodecError  # noqa: E402
+
+_ST_I = _struct.Struct("<I")
+_ST_q = _struct.Struct("<q")
+_ST_qq = _struct.Struct("<qq")
+_ST_qqI = _struct.Struct("<qqI")
+_NONE_LEN = 0xFFFFFFFF  # length sentinel: a None value (vs b"")
+_MT_BY_VALUE = list(MutationType)  # values are contiguous 0..N-1
+_CR_BY_INDEX = list(CommitResult)
+
+
+def _opt_bytes(parts: list, b: bytes | None) -> None:
+    if b is None:
+        parts.append(_ST_I.pack(_NONE_LEN))
+    else:
+        parts.append(_ST_I.pack(len(b)))
+        parts.append(b)
+
+
+def _read_opt_bytes(buf: bytes, pos: int) -> tuple[bytes | None, int]:
+    (n,) = _ST_I.unpack_from(buf, pos)
+    pos += 4
+    if n == _NONE_LEN:
+        return None, pos
+    if pos + n > len(buf):
+        raise CodecError("truncated bytes field")
+    return buf[pos : pos + n], pos + n
+
+
+def _opt_str(parts: list, s: str | None) -> None:
+    _opt_bytes(parts, None if s is None else s.encode("utf-8"))
+
+
+def _read_opt_str(buf: bytes, pos: int) -> tuple[str | None, int]:
+    b, pos = _read_opt_bytes(buf, pos)
+    return (None if b is None else b.decode("utf-8")), pos
+
+
+# ---- mutation lists (struct-of-arrays) ------------------------------------
+
+
+def _enc_muts(muts, parts: list) -> None:
+    """u32 n + 2n*u32 key/value lens + n*u8 types + joined blob."""
+    n = len(muts)
+    lens: list[int] = []
+    blobs: list[bytes] = []
+    la, ba = lens.append, blobs.append
+    for m in muts:
+        k = m.key
+        v = m.value
+        la(len(k))
+        ba(k)
+        if v is None:
+            la(_NONE_LEN)
+        else:
+            la(len(v))
+            ba(v)
+    parts.append(_struct.pack(f"<I{2 * n}I", n, *lens))
+    parts.append(bytes(m.type for m in muts))
+    parts.append(b"".join(blobs))
+
+
+def _dec_muts(buf: bytes, pos: int) -> tuple[list, int]:
+    (n,) = _ST_I.unpack_from(buf, pos)
+    pos += 4
+    lens = _struct.unpack_from(f"<{2 * n}I", buf, pos)
+    pos += 8 * n
+    types = buf[pos : pos + n]
+    if len(types) != n:
+        raise CodecError("truncated mutation types")
+    pos += n
+    muts = []
+    ma = muts.append
+    new = Mutation.__new__
+    mt = _MT_BY_VALUE
+    for i in range(n):
+        lk = lens[2 * i]
+        lv = lens[2 * i + 1]
+        k = buf[pos : pos + lk]
+        pos += lk
+        if lv == _NONE_LEN:
+            v = None
+        else:
+            v = buf[pos : pos + lv]
+            pos += lv
+        m = new(Mutation)
+        d = m.__dict__
+        d["type"] = mt[types[i]]
+        d["key"] = k
+        d["value"] = v
+        ma(m)
+    if pos > len(buf):
+        raise CodecError("truncated mutation blob")
+    return muts, pos
+
+
+def _enc_tagged_entries(entries: list, parts: list) -> None:
+    """list[(version, [Mutation])] — the TLog peek/lock payload shape."""
+    parts.append(_ST_I.pack(len(entries)))
+    for v, muts in entries:
+        parts.append(_ST_q.pack(v))
+        _enc_muts(muts, parts)
+
+
+def _dec_tagged_entries(buf: bytes, pos: int) -> tuple[list, int]:
+    (n,) = _ST_I.unpack_from(buf, pos)
+    pos += 4
+    out = []
+    for _ in range(n):
+        (v,) = _ST_q.unpack_from(buf, pos)
+        muts, pos = _dec_muts(buf, pos + 8)
+        out.append((v, muts))
+    return out, pos
+
+
+def _enc_tag_map(tags: dict, parts: list, enc_value) -> None:
+    """`u32 ntags + per tag (u32 len + utf8 + value)` — THE dict framing
+    shared by TLogCommitRequest (values: mutation lists), TLogLockReply
+    and the TLog's durable RESET record (values: tagged entry lists), so
+    a framing or bounds fix lands once."""
+    parts.append(_ST_I.pack(len(tags)))
+    for tag, value in tags.items():
+        tb = tag.encode("utf-8")
+        parts.append(_ST_I.pack(len(tb)))
+        parts.append(tb)
+        enc_value(value, parts)
+
+
+def _dec_tag_map(buf: bytes, pos: int, dec_value) -> tuple[dict, int]:
+    (ntags,) = _ST_I.unpack_from(buf, pos)
+    pos += 4
+    out: dict = {}
+    for _ in range(ntags):
+        (nt,) = _ST_I.unpack_from(buf, pos)
+        pos += 4
+        tag = buf[pos : pos + nt]
+        if len(tag) != nt:
+            raise CodecError("truncated tag name")
+        pos += nt
+        out[tag.decode("utf-8")], pos = dec_value(buf, pos)
+    return out, pos
+
+
+# ---- hot path: resolver batches -------------------------------------------
+
+
+def _enc_resolve_req(o: "ResolveTransactionBatchRequest", st, strict) -> bytes:
+    txns = o.transactions
+    n = len(txns)
+    snaps: list[int] = []
+    counts: list[int] = []
+    lens: list[int] = []
+    keys: list[bytes] = []
+    sap, cap, la, ka = snaps.append, counts.append, lens.append, keys.append
+    for t in txns:
+        sap(t.read_snapshot)
+        rr = t.read_ranges
+        wr = t.write_ranges
+        cap(len(rr))
+        cap(len(wr))
+        for b, e in rr:
+            la(len(b))
+            la(len(e))
+            ka(b)
+            ka(e)
+        for b, e in wr:
+            la(len(b))
+            la(len(e))
+            ka(b)
+            ka(e)
+    return b"".join((
+        _ST_qqI.pack(o.prev_version, o.version, n),
+        _struct.pack(f"<{n}q", *snaps),
+        _struct.pack(f"<{2 * n}I", *counts),
+        _wire.soa_encode_keys(lens, keys),
+    ))
+
+
+def _dec_resolve_req(buf: bytes, st) -> "ResolveTransactionBatchRequest":
+    prev, ver, n = _ST_qqI.unpack_from(buf, 0)
+    pos = 20
+    snaps = _struct.unpack_from(f"<{n}q", buf, pos)
+    pos += 8 * n
+    counts = _struct.unpack_from(f"<{2 * n}I", buf, pos)
+    pos += 8 * n
+    keys, end = _wire.soa_decode_keys(buf, pos)
+    if end != len(buf):
+        raise CodecError("trailing bytes after resolver batch")
+    it = iter(keys)
+    pairs = list(zip(it, it))
+    if 2 * len(pairs) != len(keys) or sum(counts) != len(pairs):
+        raise CodecError("range/key count mismatch")
+    txns = []
+    tap = txns.append
+    ci = iter(counts)
+    nci = ci.__next__
+    new = TxInfo.__new__
+    p = 0
+    for snap in snaps:
+        nr = nci()
+        q = p + nr
+        w = q + nci()
+        t = new(TxInfo)
+        d = t.__dict__
+        d["read_snapshot"] = snap
+        d["read_ranges"] = pairs[p:q]
+        d["write_ranges"] = pairs[q:w]
+        p = w
+        tap(t)
+    return ResolveTransactionBatchRequest(prev, ver, txns)
+
+
+def _enc_resolve_reply(o: "ResolveTransactionBatchReply", st, strict) -> bytes:
+    # u32 count + one byte per verdict (ints 0..2).  The count is not
+    # redundant: without it a truncated body would decode to a silently
+    # SHORTER verdict list and crash the proxy's min-combine instead of
+    # severing the connection like every other corrupt frame.
+    return _ST_I.pack(len(o.committed)) + bytes(o.committed)
+
+
+def _dec_resolve_reply(buf: bytes, st) -> "ResolveTransactionBatchReply":
+    (n,) = _ST_I.unpack_from(buf, 0)
+    if len(buf) - 4 != n:
+        raise CodecError("truncated verdict list")
+    return ResolveTransactionBatchReply(committed=list(buf[4:]))
+
+
+# ---- hot path: TLog push --------------------------------------------------
+
+
+def _enc_tlog_commit(o: "TLogCommitRequest", st, strict) -> bytes:
+    parts = [
+        _ST_qq.pack(o.prev_version, o.version),
+        _ST_q.pack(o.known_committed),
+    ]
+    _enc_tag_map(o.mutations_by_tag, parts, _enc_muts)
+    return b"".join(parts)
+
+
+def _dec_tlog_commit(buf: bytes, st) -> "TLogCommitRequest":
+    prev, ver = _ST_qq.unpack_from(buf, 0)
+    (kc,) = _ST_q.unpack_from(buf, 16)
+    by_tag, _pos = _dec_tag_map(buf, 24, _dec_muts)
+    return TLogCommitRequest(prev, ver, by_tag, known_committed=kc)
+
+
+# ---- client commit + GRV --------------------------------------------------
+
+
+def _enc_ranges(parts: list, ranges) -> None:
+    parts.append(_ST_I.pack(len(ranges)))
+    for b, e in ranges:
+        parts.append(_ST_I.pack(len(b)))
+        parts.append(b)
+        parts.append(_ST_I.pack(len(e)))
+        parts.append(e)
+
+
+def _dec_ranges(buf: bytes, pos: int) -> tuple[list, int]:
+    (n,) = _ST_I.unpack_from(buf, pos)
+    pos += 4
+    out = []
+    for _ in range(n):
+        (lb,) = _ST_I.unpack_from(buf, pos)
+        pos += 4
+        b = buf[pos : pos + lb]
+        pos += lb
+        (le,) = _ST_I.unpack_from(buf, pos)
+        pos += 4
+        e = buf[pos : pos + le]
+        pos += le
+        out.append((b, e))
+    if pos > len(buf):
+        raise CodecError("truncated range list")
+    return out, pos
+
+
+def _enc_commit_req(o: "CommitTransactionRequest", st, strict) -> bytes:
+    parts = [_ST_q.pack(o.read_snapshot)]
+    _enc_ranges(parts, o.read_conflict_ranges)
+    _enc_ranges(parts, o.write_conflict_ranges)
+    _enc_muts(o.mutations, parts)
+    _opt_str(parts, o.debug_id)
+    parts.append(b"\x01" if o.lock_aware else b"\x00")
+    return b"".join(parts)
+
+
+def _dec_commit_req(buf: bytes, st) -> "CommitTransactionRequest":
+    (snap,) = _ST_q.unpack_from(buf, 0)
+    rr, pos = _dec_ranges(buf, 8)
+    wr, pos = _dec_ranges(buf, pos)
+    muts, pos = _dec_muts(buf, pos)
+    dbg, pos = _read_opt_str(buf, pos)
+    return CommitTransactionRequest(
+        snap, rr, wr, muts, debug_id=dbg, lock_aware=buf[pos] == 1
+    )
+
+
+def _enc_commit_reply(o: "CommitReply", st, strict) -> bytes:
+    return bytes((_CR_BY_INDEX.index(o.result),)) + _ST_q.pack(o.version)
+
+
+def _dec_commit_reply(buf: bytes, st) -> "CommitReply":
+    return CommitReply(_CR_BY_INDEX[buf[0]], _ST_q.unpack_from(buf, 1)[0])
+
+
+def _register_all() -> None:
+    reg = _wire.register_codec
+    empty = _wire.register_empty_codec
+    # -- hot commit plane (16-23) --
+    reg(16, ResolveTransactionBatchRequest, _enc_resolve_req, _dec_resolve_req)
+    reg(17, ResolveTransactionBatchReply, _enc_resolve_reply, _dec_resolve_reply)
+    reg(18, TLogCommitRequest, _enc_tlog_commit, _dec_tlog_commit)
+    reg(19, CommitTransactionRequest, _enc_commit_req, _dec_commit_req)
+    reg(20, CommitReply, _enc_commit_reply, _dec_commit_reply)
+    reg(
+        21, GetCommitVersionRequest,
+        lambda o, st, x: b"".join((
+            _ST_qq.pack(o.request_num, o.committed_version),
+            o.requesting_proxy.encode("utf-8"),
+        )),
+        lambda b, st: GetCommitVersionRequest(
+            b[16:].decode("utf-8"), *_ST_qq.unpack_from(b, 0)
+        ),
+    )
+    reg(
+        22, GetCommitVersionReply,
+        lambda o, st, x: _ST_qq.pack(o.prev_version, o.version),
+        lambda b, st: GetCommitVersionReply(*_ST_qq.unpack(b)),
+    )
+    def _enc_grv_req(o, st, x):
+        parts = [bytes((o.priority,))]
+        _opt_str(parts, o.debug_id)
+        return b"".join(parts)
+
+    reg(
+        23, GetReadVersionRequest,
+        _enc_grv_req,
+        lambda b, st: GetReadVersionRequest(
+            debug_id=_read_opt_str(b, 1)[0], priority=b[0]
+        ),
+    )
+    # -- GRV / sequencer periphery (24-31) --
+    reg(
+        24, GetReadVersionReply,
+        lambda o, st, x: _ST_q.pack(o.version),
+        lambda b, st: GetReadVersionReply(_ST_q.unpack(b)[0]),
+    )
+    empty(25, GetRawCommittedVersionRequest)
+    reg(
+        26, GetRawCommittedVersionReply,
+        lambda o, st, x: _ST_q.pack(o.version),
+        lambda b, st: GetRawCommittedVersionReply(_ST_q.unpack(b)[0]),
+    )
+    # -- TLog periphery (32-39) --
+    reg(
+        32, TLogPeekRequest,
+        lambda o, st, x: _ST_q.pack(o.begin_version) + o.tag.encode("utf-8"),
+        lambda b, st: TLogPeekRequest(
+            b[8:].decode("utf-8"), _ST_q.unpack_from(b, 0)[0]
+        ),
+    )
+
+    def _enc_peek_reply(o, st, x):
+        parts = [_ST_qq.pack(o.end_version, o.known_committed)]
+        _enc_tagged_entries(o.entries, parts)
+        return b"".join(parts)
+
+    def _dec_peek_reply(b, st):
+        end, kc = _ST_qq.unpack_from(b, 0)
+        entries, _pos = _dec_tagged_entries(b, 16)
+        return TLogPeekReply(entries, end, known_committed=kc)
+
+    reg(33, TLogPeekReply, _enc_peek_reply, _dec_peek_reply)
+    reg(
+        34, TLogPopRequest,
+        lambda o, st, x: _ST_q.pack(o.upto_version) + o.tag.encode("utf-8"),
+        lambda b, st: TLogPopRequest(
+            b[8:].decode("utf-8"), _ST_q.unpack_from(b, 0)[0]
+        ),
+    )
+    empty(35, TLogConfirmRequest)
+    reg(
+        36, TLogConfirmReply,
+        lambda o, st, x: b"\x01" if o.locked else b"\x00",
+        lambda b, st: TLogConfirmReply(locked=b[0] == 1),
+    )
+
+    def _enc_lock_reply(o, st, x):
+        parts = [_ST_q.pack(o.end_version)]
+        _enc_tag_map(o.tags, parts, _enc_tagged_entries)
+        return b"".join(parts)
+
+    def _dec_lock_reply(b, st):
+        (end,) = _ST_q.unpack_from(b, 0)
+        tags, _pos = _dec_tag_map(b, 8, _dec_tagged_entries)
+        return TLogLockReply(end, tags)
+
+    empty(37, TLogLockRequest)
+    reg(38, TLogLockReply, _enc_lock_reply, _dec_lock_reply)
+    # -- resolver balancing (40-43) --
+    empty(40, ResolutionMetricsRequest)
+    reg(
+        41, ResolutionMetricsReply,
+        lambda o, st, x: _ST_q.pack(o.load),
+        lambda b, st: ResolutionMetricsReply(_ST_q.unpack(b)[0]),
+    )
+    empty(42, ResolutionSplitRequest)
+
+    def _enc_split_reply(o, st, x):
+        parts: list = []
+        _opt_bytes(parts, o.key)
+        return b"".join(parts)
+
+    reg(
+        43, ResolutionSplitReply,
+        _enc_split_reply,
+        lambda b, st: ResolutionSplitReply(_read_opt_bytes(b, 0)[0]),
+    )
+    # -- storage reads (48-55) --
+    def _enc_get_value_req(o, st, x):
+        parts = [_ST_q.pack(o.version), _ST_I.pack(len(o.key)), o.key]
+        _opt_str(parts, o.debug_id)
+        return b"".join(parts)
+
+    reg(48, GetValueRequest, _enc_get_value_req, lambda b, st: _dec_get_value_req(b))
+
+    def _enc_value_reply(o, st, x):
+        parts: list = []
+        _opt_bytes(parts, o.value)
+        return b"".join(parts)
+
+    reg(
+        49, GetValueReply,
+        _enc_value_reply,
+        lambda b, st: GetValueReply(_read_opt_bytes(b, 0)[0]),
+    )
+    reg(
+        50, GetKeyValuesRequest,
+        lambda o, st, x: b"".join((
+            _ST_qq.pack(o.version, o.limit),
+            _ST_I.pack(len(o.begin)), o.begin,
+            _ST_I.pack(len(o.end)), o.end,
+        )),
+        lambda b, st: _dec_get_kvs_req(b),
+    )
+
+    def _enc_kvs_reply(o, st, x):
+        lens: list[int] = []
+        blobs: list[bytes] = []
+        for k, v in o.data:
+            lens.append(len(k))
+            lens.append(len(v))
+            blobs.append(k)
+            blobs.append(v)
+        return b"".join((
+            b"\x01" if o.more else b"\x00",
+            _wire.soa_encode_keys(lens, blobs),
+        ))
+
+    def _dec_kvs_reply(b, st):
+        blobs, end = _wire.soa_decode_keys(b, 1)
+        if end != len(b):
+            raise CodecError("trailing bytes after kv reply")
+        it = iter(blobs)
+        return GetKeyValuesReply(list(zip(it, it)), more=b[0] == 1)
+
+    reg(51, GetKeyValuesReply, _enc_kvs_reply, _dec_kvs_reply)
+
+    def _enc_watch_req(o, st, x):
+        parts = [_ST_q.pack(o.version), _ST_I.pack(len(o.key)), o.key]
+        _opt_bytes(parts, o.value)
+        return b"".join(parts)
+
+    def _dec_watch_req(b, st):
+        (ver,) = _ST_q.unpack_from(b, 0)
+        (nk,) = _ST_I.unpack_from(b, 8)
+        key = b[12 : 12 + nk]
+        if len(key) != nk:
+            raise CodecError("truncated key")
+        value, _pos = _read_opt_bytes(b, 12 + nk)
+        return WatchValueRequest(key, value, ver)
+
+    reg(52, WatchValueRequest, _enc_watch_req, _dec_watch_req)
+
+
+def _dec_get_value_req(b: bytes) -> GetValueRequest:
+    (ver,) = _ST_q.unpack_from(b, 0)
+    (nk,) = _ST_I.unpack_from(b, 8)
+    key = b[12 : 12 + nk]
+    if len(key) != nk:
+        raise CodecError("truncated key")
+    return GetValueRequest(key, ver, debug_id=_read_opt_str(b, 12 + nk)[0])
+
+
+def _dec_get_kvs_req(b: bytes) -> GetKeyValuesRequest:
+    ver, limit = _ST_qq.unpack_from(b, 0)
+    (nb,) = _ST_I.unpack_from(b, 16)
+    begin = b[20 : 20 + nb]
+    if len(begin) != nb:
+        raise CodecError("truncated begin key")
+    (ne,) = _ST_I.unpack_from(b, 20 + nb)
+    end = b[24 + nb : 24 + nb + ne]
+    if len(end) != ne:
+        raise CodecError("truncated end key")
+    return GetKeyValuesRequest(begin, end, ver, limit=limit)
+
+
+_register_all()

@@ -8,7 +8,12 @@ processes, delivers endpoint-addressed messages with seeded latency, and
 injects faults — clogging (sim2 SimClogging :108, clogPair :1477),
 partitions, process kills/reboots (fdbrpc/simulator.h:148-153).
 
-Messages are deep-copied at send time: a simulated process can never share
+Messages cross a serialization boundary at send time: payloads with a
+registered wire codec (runtime/serialize.py, docs/WIRE.md) round-trip
+through the SAME binary encoders the real TCP transport uses — so every
+seeded simulation, chaos sweep, and serializability test exercises the
+production wire format — and anything else is deep-copied (counted as a
+codec fallback in `wire`).  Either way a simulated process can never share
 mutable state with a peer, the same isolation the wire gives the reference.
 
 The RPC vocabulary (RequestStream/ReplyPromise, fdbrpc/fdbrpc.h:217) lives
@@ -30,6 +35,8 @@ from ..runtime.core import (
     Promise,
     TaskPriority,
 )
+from ..runtime.metrics import WireStats
+from ..runtime.serialize import Unencodable, decode_payload, encode_payload
 from ..runtime.trace import TraceCollector
 
 
@@ -141,6 +148,7 @@ class SimNetwork:
         self._pair_clock: dict[tuple[NetworkAddress, NetworkAddress], float] = {}
         self.messages_sent = 0
         self.messages_dropped = 0
+        self.wire = WireStats()  # codec counters (same surface as RealNetwork)
 
     # -- topology ----------------------------------------------------------
     def create_process(self, name: str, ip: str | None = None, port: int = 4500,
@@ -195,8 +203,10 @@ class SimNetwork:
 
     # -- transport ---------------------------------------------------------
     def send(self, src: NetworkAddress, endpoint: Endpoint, payload: Any) -> None:
-        """Fire-and-forget delivery with simulated latency; payload deep-
-        copied (serialization boundary)."""
+        """Fire-and-forget delivery with simulated latency; payload crosses
+        the serialization boundary: wire-codec round trip when every nested
+        piece has a registered codec (strict mode — the production
+        encoders, exercised under every seed), deepcopy otherwise."""
         self.messages_sent += 1
         dst = endpoint.address
         if frozenset((src, dst)) in self._partitioned:
@@ -211,7 +221,17 @@ class SimNetwork:
         prev = self._pair_clock.get((src, dst), 0.0)
         when = max(when, prev)
         self._pair_clock[(src, dst)] = when
-        msg = copy.deepcopy(payload)
+        try:
+            msg = decode_payload(
+                encode_payload(payload, stats=self.wire, strict=True),
+                stats=self.wire,
+            )
+        except Unencodable:
+            # census by the INNER type for RPC envelopes: "RpcMessage" in
+            # the fallback census would hide which payload actually lacks
+            # a codec (the envelope itself always has one)
+            self.wire.note_fallback(getattr(payload, "payload", payload))
+            msg = copy.deepcopy(payload)
 
         def deliver() -> None:
             proc = self.processes.get(dst)

@@ -131,6 +131,55 @@ class RequestStream:
         self.requests.close()
 
 
+def _register_rpc_codec() -> None:
+    """RpcMessage's wire codec (runtime/serialize.py registry): reply
+    endpoint + nested payload through `encode_any`, so a registered hot
+    payload stays binary end to end and an exotic one degrades to a
+    counted pickle body — never a whole-frame pickle."""
+    import struct as _struct
+
+    from ..runtime import serialize as _wire
+
+    _ST_I = _struct.Struct("<I")
+    _ST_H = _struct.Struct("<H")
+
+    def enc(o: RpcMessage, stats, strict) -> bytes:
+        rt = o.reply_to
+        if rt is not None and rt.address is None:
+            # the decoder keys the token read off the address flag, so an
+            # address-less endpoint can't ride the codec — raising here
+            # downgrades to the counted fallback (parity preserved) rather
+            # than silently mis-framing
+            raise _wire.CodecError("reply endpoint without address")
+        tag, body = _wire.encode_any(o.payload, stats, strict)
+        parts: list = []
+        _wire.write_addr(parts, rt.address if rt is not None else None)
+        if rt is not None:
+            tok = rt.token.encode("utf-8")
+            parts.append(_ST_I.pack(len(tok)))
+            parts.append(tok)
+        parts.append(_ST_H.pack(tag))
+        parts.append(body)
+        return b"".join(parts)
+
+    def dec(buf: bytes, stats) -> RpcMessage:
+        addr, pos = _wire.read_addr(buf, 0)
+        reply_to = None
+        if addr is not None:
+            (ntok,) = _ST_I.unpack_from(buf, pos)
+            pos += 4
+            token = buf[pos : pos + ntok].decode("utf-8")
+            pos += ntok
+            reply_to = Endpoint(addr, token)
+        (tag,) = _ST_H.unpack_from(buf, pos)
+        return RpcMessage(_wire.decode_any(tag, buf[pos + 2 :], stats), reply_to)
+
+    _wire.register_codec(60, RpcMessage, enc, dec)
+
+
+_register_rpc_codec()
+
+
 class RequestStreamRef:
     """Client-side handle to a remote RequestStream."""
 

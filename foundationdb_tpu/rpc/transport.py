@@ -11,11 +11,15 @@ processes:
     `RealProcess` is the local endpoint table (the FlowTransport singleton)
   * persistent length-prefixed connections per peer, dialed on first send
     and reused both ways (the reference keeps one Peer per address)
-  * frames carry (dst_token, payload); payloads are pickled role messages
-    (the reference uses flatbuffers-style object serialization; the wire
-    discipline — framing, peer reuse, connection-failure => broken_promise —
-    is what this layer owes the stack, and runtime/serialize.py remains the
-    explicit codec for durable state)
+  * frames carry (dst_token, peer_addr, payload) in the runtime/serialize.py
+    wire-codec format (docs/WIRE.md): binary framing with hand-written
+    codecs for the hot commit-plane messages and a counted, length-guarded
+    pickle fallback for cold control traffic — the same explicit-codec
+    discipline the reference's versioned BinaryWriter wire has
+  * writes COALESCE per connection (flow/Net2's packet coalescing): frames
+    queue and flush once per reactor tick — or immediately past
+    WIRE_FLUSH_BYTES — so a commit batch's resolver/TLog fan-out costs one
+    syscall per peer, not one per message (WireStats counts frames/flush)
   * a dead/unreachable peer fails requests fast with BrokenPromise, exactly
     like the simulated fabric's connection-reset analog, so client retry
     behavior is identical in both worlds
@@ -28,7 +32,6 @@ service across real OS processes.
 
 from __future__ import annotations
 
-import pickle
 import selectors
 import socket
 import ssl
@@ -37,16 +40,26 @@ import time as _time
 from typing import Any, Callable
 
 from ..runtime.core import BrokenPromise, EventLoop, Future, TaskPriority, TimedOut
+from ..runtime.knobs import CoreKnobs
+from ..runtime.metrics import WireStats
+from ..runtime.serialize import (
+    PROTOCOL_VERSION,
+    decode_frame,
+    decode_payload,
+    encode_frame,
+    encode_payload,
+)
 from .network import Endpoint, EndpointTable, NetworkAddress
 from .stream import RpcError
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 << 20
-# every frame is a pickled (token, peer_addr, payload) tuple: even the
-# degenerate ("", None, None) pickles to 19 bytes, and real frames carry a
-# NetworkAddress (~100 bytes).  A declared length below this floor is a
-# corrupt/hostile header, rejected before any body reaches the deserializer.
-MIN_FRAME = 19
+# every frame is a codec frame (runtime/serialize.py encode_frame): u32
+# token length + token + addr flag [+ addr] + u16 payload tag.  The
+# degenerate frame (empty token, no addr, scalar payload) is 7 bytes; a
+# declared length below this floor is a corrupt/hostile header, rejected
+# before any body reaches the decoder.
+MIN_FRAME = 7
 
 
 class FrameError(ConnectionError):
@@ -96,6 +109,7 @@ class _Conn:
         self.addr = addr  # peer's LISTENING address (None until hello)
         self.out = bytearray()
         self.inbuf = bytearray()
+        self.frames_queued = 0  # since the last flush (coalescing stats)
         self.connecting = False
         self.handshaking = False  # TLS handshake in progress
         self.dead = False
@@ -106,6 +120,7 @@ class _Conn:
 
     def queue_frame(self, blob: bytes) -> None:
         self.out += _LEN.pack(len(blob)) + blob
+        self.frames_queued += 1
 
     def frames(self):
         """Yield complete frames out of inbuf.  Header validation happens
@@ -145,17 +160,26 @@ class RealNetwork:
     """TCP INetwork: one per OS process.  Surface-compatible with the slice
     of SimNetwork that rpc/stream.py and the roles actually use.
 
-    TRUST BOUNDARY: frames are pickled Python objects — deserializing gives
-    a peer code execution, so this transport is for loopback or a trusted,
-    isolated cluster network ONLY (the reference's cleartext FlowTransport
-    makes the same assumption; its TLS layer is the production answer).
-    The default bind is 127.0.0.1; binding wider is an explicit opt-in."""
+    TRUST BOUNDARY: hot commit-plane frames decode through hand-written,
+    length-validated binary codecs, but cold control payloads may still
+    ride the counted pickle fallback (TAG_PICKLE) — and unpickling gives a
+    peer code execution, so this transport remains for loopback or a
+    trusted, isolated cluster network ONLY (the reference's cleartext
+    FlowTransport makes the same assumption; its TLS layer is the
+    production answer, docs/WIRE.md has the full trust story).  The
+    default bind is 127.0.0.1; binding wider is an explicit opt-in."""
 
     def __init__(self, loop: EventLoop, name: str = "proc",
                  ip: str = "127.0.0.1", port: int = 0,
-                 tls: TLSConfig | None = None, trace=None) -> None:
+                 tls: TLSConfig | None = None, trace=None,
+                 knobs: CoreKnobs | None = None) -> None:
         self.loop = loop
         self.tls = tls
+        knobs = knobs or CoreKnobs()
+        self.wire = WireStats()
+        self._coalesce = bool(knobs.WIRE_COALESCE)
+        self._flush_bytes = int(knobs.WIRE_FLUSH_BYTES)
+        self._dirty: set[_Conn] = set()
         self.trace = trace  # optional TraceCollector for wire-error events
         self._server_ctx = tls.server_ctx() if tls else None
         self._client_ctx = tls.client_ctx() if tls else None
@@ -195,13 +219,16 @@ class RealNetwork:
     def send(self, src: NetworkAddress, endpoint: Endpoint, payload: Any) -> None:
         self.messages_sent += 1
         if endpoint.address == self.address:
-            # loopback: round-trip through pickle so co-located roles get
-            # the same serialization-boundary isolation as remote peers
-            # (SimNetwork deep-copies at send for exactly this reason)
-            blob = pickle.dumps(payload, protocol=4)
+            # loopback: round-trip through the wire CODEC (not pickle) so
+            # co-located roles get the same serialization-boundary
+            # isolation as remote peers (SimNetwork deep-copies at send
+            # for exactly this reason) AND the same encoders run in every
+            # deployment shape — snapshot-at-send copy semantics preserved
+            msg = decode_payload(encode_payload(payload, stats=self.wire),
+                                 stats=self.wire)
             self.loop._at(
                 self.loop.now(), TaskPriority.DEFAULT_ENDPOINT,
-                lambda: self.process._deliver(endpoint.token, pickle.loads(blob)),
+                lambda: self.process._deliver(endpoint.token, msg),
             )
             return
         try:
@@ -214,9 +241,14 @@ class RealNetwork:
         if reply_to is not None and reply_to.address == self.address:
             conn.pending.add(reply_to.token)
         conn.queue_frame(
-            pickle.dumps((endpoint.token, self.address, payload), protocol=4)
+            encode_frame(endpoint.token, self.address, payload, stats=self.wire)
         )
-        self._try_flush(conn)
+        # coalesce: queue now, flush once per reactor tick — unless the
+        # queue passed the byte threshold (bound memory + burst latency)
+        if not self._coalesce or len(conn.out) >= self._flush_bytes:
+            self._try_flush(conn)
+        else:
+            self._dirty.add(conn)
 
     def _break_reply(self, msg: Any) -> None:
         """Connection refused/reset before delivery: fail the caller fast
@@ -253,15 +285,34 @@ class RealNetwork:
             s, selectors.EVENT_READ | selectors.EVENT_WRITE, ("conn", conn)
         )
         # identify our listening address so the peer can reuse this
-        # connection for traffic back to us (FlowTransport's connect packet)
+        # connection for traffic back to us, and stamp our protocol version
+        # so a mixed-version pair severs with a NAMED reason instead of a
+        # bare decode-failure loop (FlowTransport's ConnectPacket carries
+        # currentProtocolVersion for the same diagnosis)
         conn.queue_frame(
-            pickle.dumps(("__hello__", self.address, None), protocol=4)
+            encode_frame("__hello__", self.address, PROTOCOL_VERSION,
+                         stats=self.wire)
         )
         return conn
 
+    def flush_queued(self) -> None:
+        """Drain the coalesced per-connection queues (one write attempt per
+        dirty connection).  Called at the top of pump(), and by WallDriver
+        for EVERY reactor before any of them blocks in select — a reply
+        queued on net B must hit the wire before net A sleeps on its poll,
+        or coalescing would add a full idle-gap to cross-net round trips."""
+        if self._dirty:
+            dirty, self._dirty = self._dirty, set()
+            for conn in dirty:
+                if not conn.dead:
+                    self._try_flush(conn)
+
     # -- reactor -------------------------------------------------------------
     def pump(self, timeout: float) -> None:
-        """Process socket readiness for up to `timeout` seconds (one poll)."""
+        """Process socket readiness for up to `timeout` seconds (one poll).
+        Coalesced frames queued since the last tick flush FIRST — before
+        the select wait — so one reactor turn never delays its own sends."""
+        self.flush_queued()
         for key, events in self._sel.select(timeout):
             kind, conn = key.data
             if kind == "accept":
@@ -337,6 +388,12 @@ class RealNetwork:
     def _try_flush(self, conn: _Conn) -> None:
         if conn.connecting or conn.handshaking or conn.dead:
             return
+        if conn.frames_queued and conn.out:
+            # one flush event drains every frame queued since the last one
+            # (frames_per_flush is the coalescing factor operators read)
+            self.wire.flushes += 1
+            self.wire.frames_flushed += conn.frames_queued
+            conn.frames_queued = 0
         try:
             while conn.out:
                 n = conn.sock.send(conn.out)
@@ -388,8 +445,12 @@ class RealNetwork:
             self._drop_conn(conn)
             return
         try:
-            decoded = [pickle.loads(b) for b in frames]
+            decoded = [decode_frame(b, self.wire) for b in frames]
         except Exception as e:  # noqa: BLE001 — corrupt peer: sever, don't die
+            # CodecError (truncated/unknown-tag codec body) and a bad
+            # pickle-fallback body land here alike: well-framed but
+            # undecodable is a deserializer-level failure — severed and
+            # counted, same containment as the oversized-header path
             self.decode_failures += 1
             self._trace_wire_error(
                 "TransportDecodeFailed", conn, Error=repr(e)[:200]
@@ -398,6 +459,18 @@ class RealNetwork:
             return
         for token, peer_addr, payload in decoded:
             if token == "__hello__":
+                if payload is not None and payload != PROTOCOL_VERSION:
+                    # mixed-version pair: sever with a NAMED reason (a
+                    # pre-codec peer never even reaches here — its pickled
+                    # hello fails decode_frame above)
+                    self._trace_wire_error(
+                        "TransportProtocolMismatch", conn,
+                        Ours=hex(PROTOCOL_VERSION),
+                        Theirs=hex(payload) if isinstance(payload, int)
+                        else repr(payload)[:40],
+                    )
+                    self._drop_conn(conn)
+                    return
                 conn.addr = peer_addr
                 # reuse this connection for outbound traffic to the peer
                 if peer_addr not in self._conns or self._conns[peer_addr].dead:
@@ -424,6 +497,7 @@ class RealNetwork:
 
     def _drop_conn(self, conn: _Conn) -> None:
         conn.dead = True
+        self._dirty.discard(conn)
         try:
             self._sel.unregister(conn.sock)
         except (KeyError, ValueError):
@@ -470,6 +544,13 @@ class WallDriver:
     def __init__(self, loop: EventLoop, pumps: list[Callable[[float], None]]) -> None:
         self.loop = loop
         self.pumps = list(pumps)
+        # reactors with coalesced write queues (bound `net.pump` methods):
+        # their queues must ALL drain before any pump blocks in select
+        self._flushers = [
+            flush
+            for p in self.pumps
+            if (flush := getattr(getattr(p, "__self__", None), "flush_queued", None))
+        ]
         self._origin = _time.monotonic() - loop.now()
 
     def _tick(self) -> None:
@@ -481,6 +562,10 @@ class WallDriver:
         while self.loop._heap and self._origin + self.loop._heap[0][0] <= now:
             self.loop.run_one()
             now = _time.monotonic()
+        # cross-reactor flush barrier: frames the timer turn just queued on
+        # ANY net go out before the FIRST net sleeps on its poll
+        for flush in self._flushers:
+            flush()
         gap = 0.02
         if self.loop._heap:
             gap = min(max((self._origin + self.loop._heap[0][0]) - now, 0.0), 0.02)

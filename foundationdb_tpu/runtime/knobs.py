@@ -116,6 +116,13 @@ class CoreKnobs(Knobs):
         self.init("DEVICE_MAX_BACKOFF", 5.0)
         self.init("DEVICE_REPROBE_INTERVAL", 5.0 if r is None else 1.0 + r.random() * 8.0)
 
+        # commit-plane wire (docs/WIRE.md): transport write coalescing.
+        # Queued frames flush once per reactor tick, or immediately once a
+        # connection's queue passes WIRE_FLUSH_BYTES (bounds both memory
+        # and burst latency); WIRE_COALESCE=false restores flush-per-send.
+        self.init("WIRE_COALESCE", True)
+        self.init("WIRE_FLUSH_BYTES", 1 << 18)
+
         # data distribution (DataDistribution.actor.cpp): storage failure
         # ping cadence, shard-size poll cadence, and the split threshold
         # (the reference splits on byte size via StorageMetrics; we count keys)

@@ -181,6 +181,60 @@ class LatencyTracker:
         }
 
 
+class WireStats:
+    """Commit-plane wire counters (the tentpole's observability surface —
+    docs/WIRE.md): bytes and wall time through the codec registry, frames
+    per transport flush, and — the trust/coverage signal — how often a
+    payload fell back to pickle (by type, so a hot message regressing onto
+    the fallback path is visible by name, not just as a count).
+
+    Wall times are host-measured (time.perf_counter) and observability
+    only: they never feed back into simulation behavior, exactly like
+    KernelStats, so determinism is unaffected."""
+
+    __slots__ = (
+        "frames_encoded", "frames_decoded", "bytes_encoded", "bytes_decoded",
+        "encode_s", "decode_s", "pickle_fallbacks", "fallback_types",
+        "flushes", "frames_flushed", "decode_fallbacks",
+    )
+
+    def __init__(self) -> None:
+        self.frames_encoded = 0
+        self.frames_decoded = 0
+        self.bytes_encoded = 0
+        self.bytes_decoded = 0
+        self.encode_s = 0.0
+        self.decode_s = 0.0
+        self.pickle_fallbacks = 0          # encode-side payloads that left the
+        self.fallback_types: dict[str, int] = {}  # codec registry (by type)
+        self.decode_fallbacks = 0          # tag-0 frames decoded
+        self.flushes = 0                   # transport write coalescing
+        self.frames_flushed = 0
+
+    def note_fallback(self, obj) -> None:
+        self.pickle_fallbacks += 1
+        name = type(obj).__name__
+        self.fallback_types[name] = self.fallback_types.get(name, 0) + 1
+
+    def snapshot(self) -> dict:
+        return {
+            "frames_encoded": self.frames_encoded,
+            "frames_decoded": self.frames_decoded,
+            "bytes_encoded": self.bytes_encoded,
+            "bytes_decoded": self.bytes_decoded,
+            "encode_ms": self.encode_s * 1e3,
+            "decode_ms": self.decode_s * 1e3,
+            "pickle_fallbacks": self.pickle_fallbacks,
+            "fallback_types": dict(self.fallback_types),
+            "decode_fallbacks": self.decode_fallbacks,
+            "flushes": self.flushes,
+            "frames_flushed": self.frames_flushed,
+            "frames_per_flush": (
+                self.frames_flushed / self.flushes if self.flushes else 0.0
+            ),
+        }
+
+
 class ContinuousSample:
     """Fixed-size uniform reservoir over a stream, with percentile reads
     (flow/ContinuousSample.h): every element ever added has equal

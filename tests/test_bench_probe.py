@@ -1,0 +1,76 @@
+"""bench.py device-probe budget contract (ISSUE r6 satellite).
+
+BENCH_r05 burned ~6 minutes on two consecutive ~180 s probe "hangs"
+despite PR 4's documented <60 s worst case.  Two holes, both pinned here:
+
+  * `subprocess.run(capture_output=True, timeout=...)` kills only the
+    direct probe child on timeout, then BLOCKS reading its pipes until
+    every grandchild holding them exits — a wedged PJRT helper stretched
+    a 20 s budget to the driver's outer bound.  `_run_probe` now runs the
+    probe in its own process group and group-kills it.
+  * driver-supplied BENCH_INIT_TIMEOUT could raise the retry budget
+    arbitrarily.  `_probe_budgets` clamps every attempt to the
+    supervisor's DEVICE_WATCHDOG_S knob.
+"""
+
+import sys
+import time
+
+import bench
+from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+WATCHDOG = CoreKnobs().DEVICE_WATCHDOG_S
+
+
+def test_probe_budgets_total_is_bounded():
+    """No env/cache combination may push total probe wall past
+    2x DEVICE_WATCHDOG_S (the documented <60 s worst case)."""
+    hostile_envs = [
+        {},
+        {"BENCH_INIT_TIMEOUT": "180"},            # the r05 driver override
+        {"BENCH_INIT_TIMEOUT": "600", "BENCH_PROBE_FAST_S": "500"},
+        {"BENCH_INIT_TIMEOUT": "nonsense", "BENCH_PROBE_FAST_S": "-x"},
+        {"BENCH_PROBE_FAST_S": "5"},
+    ]
+    for env in hostile_envs:
+        for cache in (None, {"ok": True}, {"ok": False, "detail": "down"}):
+            budgets = bench._probe_budgets(cache, env)
+            assert budgets, (env, cache)
+            assert sum(budgets) <= 2 * WATCHDOG, (env, cache, budgets)
+            assert all(b <= WATCHDOG for b in budgets), (env, cache, budgets)
+
+
+def test_probe_budgets_cached_failure_single_attempt():
+    """A cached tunnel-down verdict keeps exactly ONE short attempt."""
+    assert len(bench._probe_budgets({"ok": False}, {})) == 1
+    assert len(bench._probe_budgets({"ok": True}, {})) == 2
+    assert len(bench._probe_budgets(None, {})) == 2
+
+
+def test_run_probe_group_kill_beats_pipe_holding_grandchild(monkeypatch):
+    """THE r05 regression: a probe that spawns a long-lived grandchild
+    inheriting its stdout/stderr pipes must still be reaped within the
+    budget (+ small grace), not when the grandchild exits."""
+    hang_src = (
+        "import subprocess, sys, time\n"
+        # grandchild inherits our pipes and would hold them ~60s
+        "subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(60)'])\n"
+        "time.sleep(60)\n"
+    )
+    monkeypatch.setattr(bench, "_PROBE_SRC", hang_src)
+    t0 = time.monotonic()
+    ok, timed_out, rc, detail = bench._run_probe(2.0)
+    elapsed = time.monotonic() - t0
+    assert not ok and timed_out
+    assert "hung" in detail
+    assert elapsed < 12.0, (
+        f"probe reap took {elapsed:.1f}s for a 2s budget — the grandchild "
+        f"pipe hold is back"
+    )
+
+
+def test_run_probe_success_path(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "print('PROBE_OK fake 0.0s')")
+    ok, timed_out, rc, detail = bench._run_probe(20.0)
+    assert ok and not timed_out and rc == 0
+    assert "PROBE_OK" in detail

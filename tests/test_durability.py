@@ -374,3 +374,57 @@ class TestSsdEngineChaos:
             rows = c2.run_until(c2.loop.spawn(check()), 120)
             assert self._ring_ok(rows, nodes), f"offset={offset}: ring broken"
             c2.stop()
+
+
+class TestTLogResetCompat:
+    def test_legacy_reset_record_still_recovers(self):
+        """A disk queue written by a PRE-wire-overhaul build framed its
+        RESET record per-mutation (BinaryWriter, record type _R_RESET);
+        the overhaul writes struct-of-arrays _R_RESET2 records.  Old logs
+        must keep recovering byte-for-byte (the compatible-addition
+        contract behind the PROTOCOL_VERSION low-byte bump)."""
+        from foundationdb_tpu.roles.tlog import _R_RESET, TLog
+        from foundationdb_tpu.runtime.serialize import BinaryWriter, write_mutation
+
+        tags = {
+            "ss-0": [
+                (5, [Mutation(MutationType.SET_VALUE, b"k", b"v"),
+                     Mutation(MutationType.CLEAR_RANGE, b"a", b"z")]),
+                (7, []),
+            ],
+            "ss-1": [],
+        }
+        # the OLD builds' _encode_reset, verbatim
+        w = BinaryWriter().u8(_R_RESET).i64(5).i64(3)
+        w.u32(len(tags))
+        for tag, entries in tags.items():
+            w.str_(tag).u32(len(entries))
+            for v, muts in entries:
+                w.i64(v).u32(len(muts))
+                for m in muts:
+                    write_mutation(w, m)
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("old-tlog", None))
+        dq.push(w.data())
+        drain(loop, dq.sync())
+        end, kc, got = TLog.recover_state(DiskQueue(fs.open("old-tlog", None)))
+        assert (end, kc) == (5, 3)
+        # legacy write_mutation collapses a None value to b"" — compare
+        # against that normalization, not the wire codec's None-preserving one
+        assert got == tags
+
+    def test_new_reset_record_roundtrip(self):
+        """And the NEW record (None-preserving mutation values included)
+        round-trips through recover_state."""
+        from foundationdb_tpu.roles.tlog import _encode_reset, TLog
+
+        tags = {
+            "t": [(9, [Mutation(MutationType.SET_VALUE, b"k", None),
+                       Mutation(MutationType.ADD, b"c", b"\x01")])],
+        }
+        loop, fs = mk_env()
+        dq = DiskQueue(fs.open("new-tlog", None))
+        dq.push(_encode_reset(9, 4, tags))
+        drain(loop, dq.sync())
+        end, kc, got = TLog.recover_state(DiskQueue(fs.open("new-tlog", None)))
+        assert (end, kc, got) == (9, 4, tags)

@@ -1,7 +1,11 @@
-"""KeyRangeMap — coalescing range->value map (fdbclient/KeyRangeMap.h)."""
+"""KeyRangeMap — coalescing range->value map (fdbclient/KeyRangeMap.h) —
+and KeyPartitionMap's bisect range routing (roles/proxy.py), refereed
+against the old per-partition clip loop."""
 
 import random
 
+from foundationdb_tpu.conflict.api import TxInfo
+from foundationdb_tpu.roles.proxy import KeyPartitionMap
 from foundationdb_tpu.utils.rangemap import KeyRangeMap
 
 
@@ -77,3 +81,118 @@ def test_randomized_against_model():
         # coalescing invariant: no equal adjacent values
         vs = m._vals
         assert all(vs[i] != vs[i + 1] for i in range(len(vs) - 1))
+
+
+# ---------------------------------------------------------------------------
+# KeyPartitionMap bisect routing (the proxy's phase-2/phase-4 workhorse)
+
+
+def _clip_loop_route(pmap: KeyPartitionMap, ranges) -> dict:
+    """The OLD phase-2 routing: every partition clip-probes every range.
+    Kept here as the referee oracle for split_ranges."""
+    out = {}
+    for r in range(len(pmap.members)):
+        clipped = [c for b, e in ranges if (c := pmap.clip_to_member(r, b, e))]
+        if clipped:
+            out[r] = clipped
+    return out
+
+
+def test_partition_span_edges():
+    pmap = KeyPartitionMap([b"c", b"f"], [0, 1, 2])
+    # range spanning ALL partitions
+    assert pmap.span_for_range(b"", b"\xff") == (0, 2)
+    assert pmap.split_ranges([(b"", b"\xff")]) == {
+        0: [(b"", b"c")], 1: [(b"c", b"f")], 2: [(b"f", b"\xff")]
+    }
+    # begin == split key: routes RIGHT of the split (member_for_key parity)
+    assert pmap.span_for_range(b"c", b"d") == (1, 1)
+    assert pmap.split_ranges([(b"c", b"d")]) == {1: [(b"c", b"d")]}
+    assert pmap.member_for_key(b"c") == 1
+    # end == split key: the left partition's piece keeps `end` uncut and
+    # the right partition is NOT touched (half-open ranges)
+    assert pmap.span_for_range(b"a", b"c") == (0, 0)
+    assert pmap.split_ranges([(b"a", b"c")]) == {0: [(b"a", b"c")]}
+    # empty range: clips to nothing anywhere
+    assert pmap.span_for_range(b"d", b"d") == (0, -1)
+    assert pmap.split_ranges([(b"d", b"d"), (b"e", b"d")]) == {}
+    assert pmap.members_for_range(b"d", b"d") == []
+    # piece order within a partition follows input range order
+    got = pmap.split_ranges([(b"x", b"z"), (b"g", b"h")])
+    assert got == {2: [(b"x", b"z"), (b"g", b"h")]}
+
+
+def test_partition_no_splits_single_member():
+    pmap = KeyPartitionMap([], ["only"])
+    assert pmap.split_ranges([(b"a", b"b"), (b"", b"\xff" * 9)]) == {
+        0: [(b"a", b"b"), (b"", b"\xff" * 9)]
+    }
+    assert pmap.position_for_key(b"anything") == 0
+
+
+def test_partition_split_ranges_referee_randomized():
+    """Randomized referee: bisect routing must produce BYTE-IDENTICAL
+    per-partition clipped pieces vs the old all-partition clip loop, over
+    random split maps (including duplicate-prefix splits) and adversarial
+    ranges (empty, inverted, on-split boundaries, full-keyspace)."""
+    rng = random.Random(2026)
+    for trial in range(300):
+        n_splits = rng.randrange(0, 9)
+        splits = sorted({bytes([rng.randrange(1, 255)]) + (b"\x00" * rng.randrange(2))
+                         for _ in range(n_splits)})
+        pmap = KeyPartitionMap(splits, list(range(len(splits) + 1)))
+        ranges = []
+        for _ in range(rng.randrange(1, 12)):
+            pick = rng.random()
+            if pick < 0.25 and splits:
+                b = rng.choice(splits)  # begin exactly on a split key
+            else:
+                b = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 3)))
+            if pick > 0.8 and splits:
+                e = rng.choice(splits)  # end exactly on a split key
+            else:
+                e = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 3)))
+            if rng.random() < 0.15:
+                e = b  # empty
+            ranges.append((b, e))
+        assert pmap.split_ranges(ranges) == _clip_loop_route(pmap, ranges), (
+            trial, splits, ranges
+        )
+
+
+def test_partition_phase2_txinfo_referee():
+    """End-to-end phase-2 referee: per-resolver TxInfo lists assembled via
+    split_ranges are equal (dataclass-equal, which is field/byte equality)
+    to the old clip-loop assembly, including the empty-TxInfo padding for
+    untouched resolvers."""
+    rng = random.Random(7)
+    splits = [b"d", b"m", b"t"]
+    pmap = KeyPartitionMap(splits, [0, 1, 2, 3])
+    n_res = 4
+
+    def rkey():
+        return bytes(rng.randrange(97, 123) for _ in range(rng.randrange(0, 3)))
+
+    for _ in range(60):
+        txns = []
+        for _ in range(rng.randrange(1, 6)):
+            rr = [tuple(sorted((rkey(), rkey()))) for _ in range(rng.randrange(3))]
+            wr = [tuple(sorted((rkey(), rkey()))) for _ in range(rng.randrange(3))]
+            txns.append((rng.randrange(20), rr, wr))
+        # old assembly
+        old = [[] for _ in range(n_res)]
+        for snap, rr, wr in txns:
+            for r in range(n_res):
+                crr = [c for b, e in rr if (c := pmap.clip_to_member(r, b, e))]
+                cwr = [c for b, e in wr if (c := pmap.clip_to_member(r, b, e))]
+                old[r].append(TxInfo(snap, crr, cwr))
+        # new assembly (mirrors roles/proxy.py phase 2)
+        new = [[] for _ in range(n_res)]
+        for snap, rr, wr in txns:
+            rr_by = pmap.split_ranges(rr)
+            wr_by = pmap.split_ranges(wr)
+            for r in range(n_res):
+                crr = rr_by.get(r)
+                cwr = wr_by.get(r)
+                new[r].append(TxInfo(snap, crr or [], cwr or []))
+        assert new == old

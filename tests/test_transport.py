@@ -244,3 +244,125 @@ def test_valid_traffic_unaffected_by_frame_guards(server):
     assert out == ("echoed", None)
     assert net.frames_rejected == 0 and net.decode_failures == 0
     net.close()
+
+
+def test_truncated_codec_frame_severs_with_decode_error():
+    """A well-framed but TRUNCATED codec body (valid tag, lengths pointing
+    past the buffer) is rejected at the connection level exactly like an
+    unpicklable frame: severed + counted as a decode failure."""
+    import struct as _struct
+
+    from foundationdb_tpu.conflict.api import TxInfo
+    from foundationdb_tpu.roles.types import ResolveTransactionBatchRequest
+    from foundationdb_tpu.runtime.serialize import encode_frame
+    from foundationdb_tpu.runtime.trace import TraceCollector
+    from foundationdb_tpu.rpc.network import NetworkAddress
+
+    loop = EventLoop()
+    trace = TraceCollector(loop.now)
+    victim = RealNetwork(loop, name="victim", trace=trace)
+    good = encode_frame(
+        "wlt:resolve", NetworkAddress("127.0.0.1", 1),
+        ResolveTransactionBatchRequest(
+            1, 2, [TxInfo(1, [(b"abcdef", b"abcdef\x00")], [])] * 4
+        ),
+    )
+    body = good[: len(good) - 9]  # cut mid key blob: lengths now lie
+    blob = _struct.pack("<I", len(body)) + body
+    severed = _hostile_send(victim.address.port, blob,
+                            also_valid_probe=victim.pump)
+    assert severed, "victim kept the corrupt-codec connection open"
+    assert victim.frames_rejected == 0
+    assert victim.decode_failures == 1
+    assert len(trace.find("TransportDecodeFailed")) == 1
+    victim.close()
+
+
+def test_write_coalescing_frames_per_flush(server):
+    """A burst of sends queued in one reactor turn must leave in ONE
+    coalesced write (frames_per_flush ≈ burst size), and every frame must
+    still arrive."""
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client")
+    drv = NetDriver(loop, net)
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", server), "wlt:echo")
+    )
+
+    async def burst():
+        futs = [ref.get_reply({"n": i}, timeout=5.0) for i in range(32)]
+        out = []
+        for f in futs:
+            out.append(await f)
+        return out
+
+    out = drv.run_until(loop.spawn(burst()), wall_timeout=20.0)
+    assert [o[1]["n"] for o in out] == list(range(32))
+    snap = net.wire.snapshot()
+    # 32 requests + 1 hello queued before the first pump tick: at least a
+    # 4x coalescing factor even if the reactor splits the burst
+    assert snap["frames_per_flush"] >= 4.0, snap
+    assert net.wire.pickle_fallbacks <= 33  # dict payloads pickle, counted
+    net.close()
+
+
+def test_flush_byte_threshold_bounds_queue(server):
+    """Past WIRE_FLUSH_BYTES the queue flushes inside send() (the memory
+    bound): with a tiny threshold a burst degrades toward flush-per-send
+    — many more flush events than the coalesced default — while traffic
+    still round-trips correctly."""
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+    from foundationdb_tpu.rpc.network import Endpoint, NetworkAddress
+
+    knobs = CoreKnobs()
+    knobs.WIRE_FLUSH_BYTES = 1  # every queued frame passes the threshold
+    loop = EventLoop()
+    net = RealNetwork(loop, name="client", knobs=knobs)
+    drv = NetDriver(loop, net)
+    ref = RequestStreamRef(
+        net, net.process, Endpoint(NetworkAddress("127.0.0.1", server), "wlt:echo")
+    )
+
+    async def burst():
+        # warm the connection first: sends queued while still CONNECTING
+        # legitimately coalesce regardless of threshold
+        await ref.get_reply({"n": -1}, timeout=5.0)
+        futs = [ref.get_reply({"n": i}, timeout=5.0) for i in range(16)]
+        return [await f for f in futs]
+
+    out = drv.run_until(loop.spawn(burst()), wall_timeout=20.0)
+    assert [o[1]["n"] for o in out] == list(range(16))
+    snap = net.wire.snapshot()
+    # on the warm connection every send crosses the 1-byte threshold and
+    # flushes itself: flush count approaches frame count
+    assert snap["flushes"] >= 10, snap
+    net.close()
+
+
+def test_protocol_mismatch_hello_severs_with_named_reason():
+    """A peer stamping a DIFFERENT protocol version in its hello is severed
+    with a traced TransportProtocolMismatch naming both versions — not a
+    bare decode-failure loop."""
+    import struct as _struct
+
+    from foundationdb_tpu.runtime.serialize import PROTOCOL_VERSION, encode_frame
+    from foundationdb_tpu.runtime.trace import TraceCollector
+    from foundationdb_tpu.rpc.network import NetworkAddress
+
+    loop = EventLoop()
+    trace = TraceCollector(loop.now)
+    victim = RealNetwork(loop, name="victim", trace=trace)
+    body = encode_frame(
+        "__hello__", NetworkAddress("127.0.0.1", 1), PROTOCOL_VERSION + 1
+    )
+    blob = _struct.pack("<I", len(body)) + body
+    severed = _hostile_send(victim.address.port, blob,
+                            also_valid_probe=victim.pump)
+    assert severed, "victim kept the mixed-version connection open"
+    evs = trace.find("TransportProtocolMismatch")
+    assert len(evs) == 1
+    assert evs[0]["Ours"] == hex(PROTOCOL_VERSION)
+    assert evs[0]["Theirs"] == hex(PROTOCOL_VERSION + 1)
+    victim.close()
