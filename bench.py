@@ -423,7 +423,8 @@ def _init_backend() -> dict:
 
 
 def _emit(metric: str, value: float, vs_baseline: float, error: str | None = None,
-          kernel: dict | None = None, commit_wire: dict | None = None) -> None:
+          kernel: dict | None = None, commit_wire: dict | None = None,
+          metrics_series: dict | None = None) -> None:
     doc = {
         "metric": metric,
         "value": round(value, 1),
@@ -442,7 +443,59 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
         # wall + bytes for a bench-class resolver batch and TLog push,
         # speedup vs protocol-4 pickle, and the transport coalescing factor
         doc["commit_wire"] = commit_wire
+    if metrics_series is not None:
+        # per-role *Metrics time-series from a fixed sim commit workload
+        # (docs/OBSERVABILITY.md "Distributed tracing"): resolver-metrics
+        # samples over the run, not just an end-of-run snapshot
+        doc["metrics_series"] = metrics_series
     print(json.dumps(doc))
+
+
+def _metrics_series_probe(n_commits: int = 200) -> dict | None:
+    """The periodic-metrics time-series BENCH artifact: a fixed sim commit
+    workload with a fast METRICS_INTERVAL, returning every ResolverMetrics
+    emission — rates per interval, the conflict backend's phase-wall
+    deltas, and the MVCC version floor over (simulated) time.  CPU-only
+    (oracle backend on the sim fabric), so it runs on device and
+    no-device rounds alike."""
+    try:
+        from foundationdb_tpu.cluster import SimCluster
+        from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+        knobs = CoreKnobs()
+        knobs.METRICS_INTERVAL = 0.25
+        c = SimCluster(seed=5, n_resolvers=2, n_tlogs=1, knobs=knobs)
+        db = c.database()
+
+        async def drive():
+            for i in range(n_commits):
+                tr = db.create_transaction()
+                tr.set(b"m%04d" % (i % 97), b"v%04d" % i)
+                await tr.commit()
+
+        c.run_until(c.loop.spawn(drive()), 120.0)
+        series = [
+            {
+                "t": round(e["Time"], 4),
+                "instance": e["Instance"],  # two resolvers interleave here
+                "txns_per_sec": round(e["TxnsPerSec"], 1),
+                "conflicts_per_sec": round(e["ConflictsPerSec"], 1),
+                "version": e["Version"],
+                "kernel_resolve_ms_delta": round(e["KernelResolveMsDelta"], 3),
+            }
+            for e in c.trace.find("ResolverMetrics")
+        ]
+        c.stop()
+        if not series:
+            return None
+        return {
+            "interval_s": 0.25,
+            "workload_commits": n_commits,
+            "ResolverMetrics": series,
+        }
+    except Exception as e:  # noqa: BLE001 — the series is additive data
+        print(f"[bench] metrics series probe failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def _commit_wire_probe(n_txns: int = 4096, reps: int = 5) -> dict | None:
@@ -762,6 +815,7 @@ def main() -> None:
             error=f"device backend unavailable: {init.get('error', '?')[:500]}",
             kernel=kern,
             commit_wire=wire,
+            metrics_series=_metrics_series_probe(),
         )
         os._exit(0)  # daemon init thread may be wedged in PJRT; exit hard
     backend = init["backend"]
@@ -1027,6 +1081,7 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
         native_s / device_s,
         kernel=kernel,
         commit_wire=_commit_wire_probe(),
+        metrics_series=_metrics_series_probe(),
     )
 
 

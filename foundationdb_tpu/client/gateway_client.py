@@ -41,6 +41,7 @@ class Transaction:
     def __init__(self, db: "GatewayClient", tid: int) -> None:
         self._db = db
         self._tid = tid
+        self.debug_id: str | None = None  # set by set_debug_id
 
     def _body(self, *parts) -> bytearray:
         """bytes parts are length-prefixed strings; bytearray parts are RAW
@@ -99,6 +100,16 @@ class Transaction:
     def set_option(self, option: bytes) -> None:
         self._db._call(13, self._body(option))
 
+    def set_debug_id(self, debug_id: str) -> None:
+        """Sample this transaction into the DISTRIBUTED trace plane: the
+        id rides SET_OPTION (debug_transaction_identifier) so the server's
+        pipeline stations join it, and this process's own commit stations
+        land in its local g_trace_batch — which, when bound to a
+        TraceCollector with a file sink, gives the CLIENT process its own
+        trace file for tools/trace_tool.py to join by debug ID."""
+        self.debug_id = debug_id
+        self.set_option(b"debug_transaction_identifier=" + debug_id.encode())
+
     def watch(self, key: bytes) -> int:
         """BLOCKS this connection until `key`'s value changes; returns the
         firing version.  Use a dedicated GatewayClient for watches — the
@@ -115,7 +126,15 @@ class Transaction:
         return struct.unpack_from("<q", body, 0)[0]
 
     def commit(self) -> int:
+        if self.debug_id is not None:
+            from ..runtime.trace import g_trace_batch
+
+            g_trace_batch.add("GatewayClient.commit.Before", self.debug_id)
         body = self._db._call(8, self._body())
+        if self.debug_id is not None:
+            from ..runtime.trace import g_trace_batch
+
+            g_trace_batch.add("GatewayClient.commit.After", self.debug_id)
         return struct.unpack_from("<q", body, 0)[0]
 
     def on_error(self, code: int) -> None:

@@ -44,11 +44,17 @@ class SimCluster:
         self.loop = EventLoop()
         self.rng = DeterministicRandom(seed)
         self.knobs = knobs or CoreKnobs()
-        self.trace = TraceCollector(clock=self.loop.now)
-        from .runtime.trace import g_trace_batch
+        self.trace = TraceCollector(
+            clock=self.loop.now, min_severity=self.knobs.TRACE_SEVERITY
+        )
+        from .runtime.trace import g_trace_batch, spawn_wire_metrics
 
-        g_trace_batch.attach_clock(self.loop.now)
+        g_trace_batch.attach_clock(self.loop.now, self.trace)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
+        self._wire_metrics_task = spawn_wire_metrics(
+            self.loop, self.trace, self.net.wire,
+            self.knobs.METRICS_INTERVAL, "sim",
+        )
         make_cs = conflict_backend or OracleConflictSet
 
         # default splits: evenly spread single-byte prefixes
@@ -115,6 +121,19 @@ class SimCluster:
 
         self.client_proc = self.net.create_process("client")
 
+        # the periodic *Metrics plane (runtime/trace.py spawn_role_metrics):
+        # the statically-wired cluster starts every role's emitter itself —
+        # the controller does this per generation in the full topology
+        iv = self.knobs.METRICS_INTERVAL
+        self.sequencer.start_metrics(self.trace, iv)
+        self.proxy.start_metrics(self.trace, iv)
+        for r in self.resolvers:
+            r.start_metrics(self.trace, iv)
+        for t in self.tlogs:
+            t.start_metrics(self.trace, iv)
+        for ss in self.storage:
+            ss.start_metrics(self.trace, iv)
+
     def _ref(self, process, endpoint) -> RequestStreamRef:
         return RequestStreamRef(self.net, process, endpoint)
 
@@ -145,6 +164,7 @@ class SimCluster:
         return self.loop.run_until(fut, deadline)
 
     def stop(self) -> None:
+        self._wire_metrics_task.cancel()
         self.proxy.stop()
         for r in self.resolvers:
             r.stop()

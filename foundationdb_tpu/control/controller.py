@@ -442,6 +442,7 @@ class ClusterController:
                         self.fs.delete(path)
 
             self.generation = gen
+            self._start_generation_metrics(gen)
             for p in gen.proxies:
                 p.locked = self._locked  # the lock survives recoveries
             self._set_state(RecoveryState.ACCEPTING_COMMITS)
@@ -1261,6 +1262,22 @@ class ClusterController:
             if self.loop.now() >= deadline:
                 raise TimedOut("commit plane never drained for rebalance")
             await self.loop.delay(0.005, TaskPriority.COORDINATION)
+
+    def _start_generation_metrics(self, gen: GenerationRoles) -> None:
+        """Every pipeline role of the newly installed generation emits its
+        periodic `*Metrics` trace event (flow/Stats.h traceCounters cadence)
+        into the cluster collector.  The emitters die with the role — via
+        role.stop() or, for a deposed directly-constructed role, via the
+        process-alive guard in spawn_role_metrics — so a stale generation
+        never narrates over its successor."""
+        iv = self.knobs.METRICS_INTERVAL
+        gen.sequencer.start_metrics(self.trace, iv)
+        for p in gen.proxies:
+            p.start_metrics(self.trace, iv)
+        for r in gen.resolvers:
+            r.start_metrics(self.trace, iv)
+        for t in gen.tlogs:
+            t.start_metrics(self.trace, iv)
 
     def _teardown_generation(self, gen: GenerationRoles) -> None:
         """Dispose a generation that must not serve (lost cstate race,

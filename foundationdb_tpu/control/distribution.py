@@ -204,6 +204,7 @@ class DataDistributor:
             tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
             tag=tag, store=store, start_version=start_v,
         )
+        new_ss.start_metrics(cc.trace, self.knobs.METRICS_INTERVAL)
         cc.replace_storage_server(dead, new_ss)
         self._watch(new_ss)
         futs = []
@@ -320,6 +321,7 @@ class DataDistributor:
             tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
             tag=tag, store=store, start_version=start_v,
         )
+        new_ss.start_metrics(cc.trace, self.knobs.METRICS_INTERVAL)
         cc.replace_storage_server(victim, new_ss)
         self._watch(new_ss)
         futs = []
@@ -451,6 +453,7 @@ class DataDistributor:
             tlog_pop_ref=RequestStreamRef(self.net, proc, tlog.pop_stream.endpoint),
             tag=tag, store=store, start_version=start_v,
         )
+        new_ss.start_metrics(cc.trace, self.knobs.METRICS_INTERVAL)
         cc._tag_to_ss[tag] = new_ss
         cc.storage.append(new_ss)
         new_teams = [list(t) for t in teams]

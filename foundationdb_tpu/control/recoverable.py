@@ -99,11 +99,21 @@ class RecoverableCluster:
             _buggify.disable()
             self.knobs = knobs or CoreKnobs()
             self.client_knobs = ClientKnobs()
-        self.trace = TraceCollector(clock=self.loop.now, sink=trace_sink)
-        from ..runtime.trace import g_trace_batch
+        self.trace = TraceCollector(
+            clock=self.loop.now, sink=trace_sink,
+            min_severity=self.knobs.TRACE_SEVERITY,
+        )
+        from ..runtime.trace import g_trace_batch, spawn_wire_metrics
 
-        g_trace_batch.attach_clock(self.loop.now)
+        # the collector bind mirrors every pipeline station into the trace
+        # stream (and thus the trace FILES a production server rolls) as
+        # TransactionDebug events — the cross-process join key surface
+        g_trace_batch.attach_clock(self.loop.now, self.trace)
         self.net = SimNetwork(self.loop, self.rng, self.trace)
+        self._wire_metrics_task = spawn_wire_metrics(
+            self.loop, self.trace, self.net.wire,
+            self.knobs.METRICS_INTERVAL, "sim",
+        )
         make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))
         self.fs = None
         if durable or fs is not None or restart:
@@ -250,14 +260,14 @@ class RecoverableCluster:
                     else 0
                 )
                 # initial refs are dummies; the controller rewires on first recovery
-                self.storage.append(
-                    StorageServer(
-                        p, self.loop, self.knobs,
-                        tlog_peek_ref=None, tlog_pop_ref=None,
-                        tag=f"ss-{i}-r{r}", store=store,
-                        start_version=start_version,
-                    )
+                ss = StorageServer(
+                    p, self.loop, self.knobs,
+                    tlog_peek_ref=None, tlog_pop_ref=None,
+                    tag=f"ss-{i}-r{r}", store=store,
+                    start_version=start_version,
                 )
+                ss.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
+                self.storage.append(ss)
         if self.machines:
             # the policy object VALIDATES what the placement formula built —
             # the team builder must refuse same-failure-domain teams
@@ -479,6 +489,7 @@ class RecoverableCluster:
         self.log_router = LogRouter(
             rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
         )
+        self.log_router.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
         self.controller.stream_consumers[ROUTER_TAG] = self.log_router
 
     def restart_log_router(self) -> None:
@@ -505,6 +516,7 @@ class RecoverableCluster:
         self.log_router = LogRouter(
             rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
         )
+        self.log_router.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
         cc = self.controller
         cc.stream_consumers[ROUTER_TAG] = self.log_router
         gen = cc.generation
@@ -523,19 +535,19 @@ class RecoverableCluster:
         for i in range(n_storage_shards):
             p = self.net.create_process(f"remote-storage-{i}")
             store = make_store(f"remote{i}.kv", p)
-            self.remote_storage.append(
-                StorageServer(
-                    p, self.loop, self.knobs,
-                    tlog_peek_ref=_Ref(self.net, p, self.log_router.peek_stream.endpoint),
-                    tlog_pop_ref=_Ref(self.net, p, self.log_router.pop_stream.endpoint),
-                    tag=f"remote-{i}-r0",
-                    store=store,
-                    start_version=(
-                        store.meta.get("durable_version", 0)
-                        if self.fs is not None else 0
-                    ),
-                )
+            ss = StorageServer(
+                p, self.loop, self.knobs,
+                tlog_peek_ref=_Ref(self.net, p, self.log_router.peek_stream.endpoint),
+                tlog_pop_ref=_Ref(self.net, p, self.log_router.pop_stream.endpoint),
+                tag=f"remote-{i}-r0",
+                store=store,
+                start_version=(
+                    store.meta.get("durable_version", 0)
+                    if self.fs is not None else 0
+                ),
             )
+            ss.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
+            self.remote_storage.append(ss)
 
     async def promote_remote_region(self) -> bool:
         """Region failover's write half: adopt the remote replicas as the
@@ -695,6 +707,7 @@ class RecoverableCluster:
             cluster2 = RecoverableCluster(seed=..., fs=fs, restart=True)
         """
         assert self.fs is not None, "power_off needs a durable cluster"
+        self._wire_metrics_task.cancel()
         if getattr(self, "_monitor_task", None) is not None:
             self._monitor_task.cancel()
         for w in self.workers:
@@ -715,6 +728,7 @@ class RecoverableCluster:
         return self.fs
 
     def stop(self) -> None:
+        self._wire_metrics_task.cancel()
         if getattr(self, "_monitor_task", None) is not None:
             self._monitor_task.cancel()
         for w in self.workers:

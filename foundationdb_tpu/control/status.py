@@ -429,6 +429,111 @@ STATUS_SCHEMA: dict = {
 }
 
 
+# -- periodic role-metrics events (runtime/trace.py spawn_role_metrics) ------
+#
+# The `*Metrics` vocabulary every role emits each METRICS_INTERVAL: one
+# schema per event type, validated with the same field-spec machinery as
+# the status document (the reference's status-schema discipline applied to
+# the trace plane — tests assert every role type emits a conforming event
+# within one interval).
+
+_NUM = (int, float)
+
+ROLE_METRICS_SCHEMA: dict = {
+    "ProxyMetrics": {
+        "Elapsed": _NUM,
+        "TxnsCommittedPerSec": _NUM,
+        "TxnsConflictedPerSec": _NUM,
+        "CommitBatchesPerSec": _NUM,
+        "ThrottlesPerSec": _NUM,
+        "CommittedVersion": int,
+        "BatchInterval": _NUM,
+        "CommitP99Ms": _NUM,
+        "GrvP99Ms": _NUM,
+    },
+    "ResolverMetrics": {
+        "Elapsed": _NUM,
+        "BatchesPerSec": _NUM,
+        "TxnsPerSec": _NUM,
+        "ConflictsPerSec": _NUM,
+        "Version": int,
+        "OldestVersion": int,
+        "LatencyP99Ms": _NUM,
+        "KernelBackend": str,
+        "KernelBatchesDelta": int,
+        "KernelPackMsDelta": _NUM,
+        "KernelResolveMsDelta": _NUM,
+        "KernelMergeMsDelta": _NUM,
+        "DeviceState?": str,
+        "DeviceServing?": str,
+        "DeviceTrips?": int,
+    },
+    "TLogMetrics": {
+        "Elapsed": _NUM,
+        "Version": int,
+        "KnownCommitted": int,
+        "BytesQueued": int,
+        "SpillEvents": int,
+        "Locked": bool,
+        "CommitsPerSec": _NUM,
+        "BytesPerSec": _NUM,
+    },
+    "StorageMetrics": {
+        "Elapsed": _NUM,
+        "Tag": str,
+        "Version": int,
+        "DurableVersion": int,
+        "KnownCommitted": int,
+        "Keys": int,
+        "ReadsPerSec": _NUM,
+        "MutationsPerSec": _NUM,
+        "ReadP99Ms": _NUM,
+    },
+    "SequencerMetrics": {
+        "Elapsed": _NUM,
+        "LastAssigned": int,
+        "MaxCommitted": int,
+        "RequestsPerSec": _NUM,
+        "VersionsAssignedPerSec": _NUM,
+    },
+    "LogRouterMetrics": {
+        "Elapsed": _NUM,
+        "Version": int,
+        "KnownCommitted": int,
+        "EntriesPerSec": _NUM,
+        "QueueDepth": int,
+    },
+    "WireMetrics": {
+        "Elapsed": _NUM,
+        "Source": str,
+        "FramesEncodedPerSec": _NUM,
+        "FramesDecodedPerSec": _NUM,
+        "BytesEncodedPerSec": _NUM,
+        "BytesDecodedPerSec": _NUM,
+        "PickleFallbacks": int,
+        "DecodeFallbacks": int,
+        "FramesPerFlush": _NUM,
+    },
+}
+
+
+# every emission carries its per-instance attribution (spawn_role_metrics
+# stamps it centrally, so the event stream stays separable when several
+# same-role instances share one process)
+for _spec in ROLE_METRICS_SCHEMA.values():
+    _spec["Instance"] = str
+
+
+def validate_metrics_event(ev: dict) -> None:
+    """Raise ValueError where a `*Metrics` trace event violates its schema
+    (unknown metrics event types also raise: a new role metric must be
+    schema-listed before it ships)."""
+    spec = ROLE_METRICS_SCHEMA.get(ev.get("Type"))
+    if spec is None:
+        raise ValueError(f"unknown metrics event type {ev.get('Type')!r}")
+    validate_status(ev, spec, f"metrics.{ev['Type']}")
+
+
 def validate_status(doc, schema=None, path: str = "status") -> None:
     """Raise ValueError where `doc` violates the schema — the analog of the
     reference's schema-checked status (Status.actor.cpp checks emitted docs

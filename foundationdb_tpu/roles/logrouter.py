@@ -32,6 +32,7 @@ from .types import (
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
+from ..runtime.trace import CounterCollection, spawn_role_metrics
 
 ROUTER_TAG = "router-0"
 
@@ -55,6 +56,9 @@ class LogRouter:
             t: [] for team in remote_map.members for t in team
         }
         self._remote_pops: dict[str, Version] = {t: start_version for t in self._tags}
+        self.counters = CounterCollection("LogRouter")
+        self.c_entries = self.counters.counter("entries_relayed")
+        self._metrics_emitter = None
         self.peek_stream = RequestStream(process, self.WLT_PEEK, unique=True)
         self.pop_stream = RequestStream(process, self.WLT_POP, unique=True)
         self._tasks = [
@@ -97,6 +101,7 @@ class LogRouter:
                             by_tag.setdefault(t, []).append(m)
                 for t, tmuts in by_tag.items():
                     self._tags[t].append((version, tmuts))
+                self.c_entries.add(1)
                 self._fetched = version
                 self.version.set(version)
             tail = reply.end_version - 1
@@ -139,8 +144,31 @@ class LogRouter:
                 self._tags[r.tag] = q[i:]
             req.reply(None)
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic LogRouterMetrics emission (relay progress + retained
+        backlog — the router buffering contract's observable)."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            return {
+                "Version": self.version.get(),
+                "KnownCommitted": self.known_committed,
+                "EntriesPerSec": r.get("entries_relayed", 0.0),
+                "QueueDepth": sum(len(q) for q in self._tags.values()),
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.process, trace, "LogRouterMetrics", fields,
+            interval, TaskPriority.STORAGE_SERVER,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         self.peek_stream.close()
         self.pop_stream.close()

@@ -48,7 +48,7 @@ from ..runtime.core import BrokenPromise, EventLoop, FutureStream, TaskPriority,
 from ..runtime.knobs import CoreKnobs
 from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.metrics import LatencyTracker
-from ..runtime.trace import CounterCollection, g_trace_batch
+from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
 from ..runtime.coverage import testcov
 
 
@@ -231,6 +231,7 @@ class CommitProxy:
             "tlog_push": LatencyTracker(),
         }
         self._pending: list[_PendingCommit] = []
+        self._metrics_emitter = None
         self._batch_tasks: list = []  # in-flight commit batches (stop() kills)
         self._batch_interval = knobs.COMMIT_BATCH_INTERVAL_MIN
         self._paused = 0        # drain barrier refcount (rebalance + DD)
@@ -331,15 +332,18 @@ class CommitProxy:
                 idle += self._batch_interval
 
     # -- phases 2-5 ----------------------------------------------------------
-    async def _retry_reply(self, ref: RequestStreamRef, payload, deadline: float):
+    async def _retry_reply(self, ref: RequestStreamRef, payload, deadline: float,
+                           spans: tuple | None = None):
         """get_reply with bounded retries: every commit-path RPC is
         idempotent under retry (sequencer dedups request_num, resolvers
         abort-all on duplicate versions, TLogs re-ack), so a dropped packet
-        costs a retry instead of a permanently wedged version chain."""
+        costs a retry instead of a permanently wedged version chain.
+        `spans` rides the RpcMessage envelope so downstream roles land
+        their pipeline stations under the batch's sampled debug IDs."""
         attempt = 0
         while True:
             try:
-                return await ref.get_reply(payload, timeout=1.0)
+                return await ref.get_reply(payload, timeout=1.0, spans=spans)
             except (TimedOut, BrokenPromise):
                 attempt += 1
                 if self._failed or self.loop.now() >= deadline:
@@ -398,6 +402,7 @@ class CommitProxy:
         # must cost nothing on the un-sampled hot path
         dbg = [pc.request.debug_id for pc in batch
                if pc.request.debug_id is not None]
+        spans = tuple(dbg) if dbg else None
         for d in dbg:
             g_trace_batch.add("CommitProxyServer.commitBatch.Before", d)
         gv: GetCommitVersionReply = await self._retry_reply(
@@ -406,6 +411,7 @@ class CommitProxy:
                 self.name, self._req_num, self.committed_version.get()
             ),
             deadline,
+            spans=spans,
         )
         prev_v, version = gv.prev_version, gv.version
         if batch:
@@ -471,6 +477,7 @@ class CommitProxy:
                         self.resolvers[r],
                         ResolveTransactionBatchRequest(prev_v, version, per_res[r]),
                         deadline,
+                        spans=spans,
                     ),
                     TaskPriority.PROXY_COMMIT,
                 )
@@ -590,6 +597,7 @@ class CommitProxy:
                             known_committed=self.committed_version.get(),
                         ),
                         deadline,
+                        spans=spans,
                     ),
                     TaskPriority.PROXY_COMMIT,
                 )
@@ -809,8 +817,35 @@ class CommitProxy:
                 grv_lat.observe(t_reply - arrive)
                 r.reply(GetReadVersionReply(version))
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic ProxyMetrics emission (the reference's ProxyMetrics
+        event): rate-converted commit counters + the live SLO tail."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            return {
+                "TxnsCommittedPerSec": r.get("txns_committed", 0.0),
+                "TxnsConflictedPerSec": r.get("txns_conflicted", 0.0),
+                "CommitBatchesPerSec": r.get("commit_batches", 0.0),
+                "ThrottlesPerSec": r.get("mvcc_window_throttles", 0.0),
+                "CommittedVersion": self.committed_version.get(),
+                "BatchInterval": self._batch_interval,
+                "CommitP99Ms": self.latency["commit"].snapshot()["p99"] * 1e3,
+                "GrvP99Ms": self.latency["grv"].snapshot()["p99"] * 1e3,
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.commit_stream._process, trace, "ProxyMetrics",
+            fields, interval, TaskPriority.PROXY_COMMIT,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         self._stopping = True  # cancellation is teardown, not a failure
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         for t in self._tasks:
             t.cancel()
         # a deposed proxy's in-flight batches must NOT complete later: the

@@ -51,7 +51,7 @@ from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.knobs import CoreKnobs
 from ..runtime.metrics import LatencyTracker
-from ..runtime.trace import CounterCollection
+from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
 
 
 # idle-stream flush bound for the split-phase path: if no successor batch
@@ -69,6 +69,7 @@ class _PendingBatch:
     handle: ResolveHandle
     t0: float
     moved_in: list  # moved-range guards as of dispatch (the sync path's view)
+    spans: tuple    # sampled debug IDs that rode the request envelope
 
 
 class Resolver:
@@ -129,6 +130,7 @@ class Resolver:
         # pending between its dispatch and its successor's dispatch
         self._pipeline = pipeline_enabled(False) if pipeline is None else pipeline
         self._pending: _PendingBatch | None = None
+        self._metrics_emitter = None
         self.metrics_stream = RequestStream(process, self.WLT_METRICS, unique=True)
         self._task = loop.spawn(self._serve(), TaskPriority.RESOLVER, "resolver")
         self._metrics_task = loop.spawn(
@@ -145,8 +147,16 @@ class Resolver:
     async def _resolve_one(self, req) -> None:
         r: ResolveTransactionBatchRequest = req.payload
         t0 = self.loop.now()
+        # wire-propagated trace context (rpc/stream.py RpcMessage.spans):
+        # sampled debug IDs land THIS role's stations in the local process's
+        # TraceBatch — the reference's Resolver.resolveBatch stations
+        spans = req.spans or ()
+        for d in spans:
+            g_trace_batch.add("Resolver.resolveBatch.Before", d)
         await maybe_delay(self.loop, "resolver.delay_resolve")
         await self.version.when_at_least(r.prev_version)
+        for d in spans:
+            g_trace_batch.add("Resolver.resolveBatch.AfterOrderer", d)
         if self.version.get() >= r.version:
             # duplicate delivery (proxy retry after timeout): the retried
             # version's verdicts may still be deferred in the pipeline —
@@ -170,7 +180,7 @@ class Resolver:
             return
         self._sample_load(r.transactions)
         if self._pipeline:
-            await self._resolve_pipelined(req, r, t0)
+            await self._resolve_pipelined(req, r, t0, spans)
             return
         verdicts = self.cs.resolve_batch(r.version, r.transactions)
         if self._moved_in:
@@ -185,10 +195,12 @@ class Resolver:
         self._reply_cache[r.version] = committed
         self.version.set(r.version)
         self.latency.observe(self.loop.now() - t0)
+        for d in spans:
+            g_trace_batch.add("Resolver.resolveBatch.After", d)
         req.reply(ResolveTransactionBatchReply(committed=committed))
 
     # -- split-phase pipeline (module docstring) ------------------------------
-    async def _resolve_pipelined(self, req, r, t0: float) -> None:
+    async def _resolve_pipelined(self, req, r, t0: float, spans=()) -> None:
         """Dispatch this batch, advance the chain, reply the PREVIOUS batch.
 
         State transitions happen in exactly the synchronous order —
@@ -197,7 +209,7 @@ class Resolver:
         verdict FETCH is deferred, which is what lets batch N+1's host phase
         (packing) overlap batch N's device execution."""
         handle = self.cs.resolve_deferred(r.version, r.transactions)
-        pend = _PendingBatch(req, r, handle, t0, list(self._moved_in))
+        pend = _PendingBatch(req, r, handle, t0, list(self._moved_in), tuple(spans))
         self._advance_window(r.version)  # same dispatch-order GC as sync
         prev, self._pending = self._pending, pend
         self.version.set(r.version)  # successor may now pack + dispatch
@@ -225,6 +237,8 @@ class Resolver:
         committed = [int(v) for v in verdicts]
         self._reply_cache[pend.r.version] = committed
         self.latency.observe(self.loop.now() - pend.t0)
+        for d in pend.spans:
+            g_trace_batch.add("Resolver.resolveBatch.After", d)
         pend.req.reply(ResolveTransactionBatchReply(committed=committed))
 
     def _flush_pending(self) -> None:
@@ -252,10 +266,55 @@ class Resolver:
         for v in stale:
             del self._reply_cache[v]
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic ResolverMetrics emission: rate-converted role counters
+        plus the conflict backend's KernelStats PHASE DELTAS over the
+        interval (wall ms spent packing/resolving/merging since the last
+        emission — the time-series ROADMAP item 1 tunes against) and the
+        DeviceSupervisor state when the backend is supervised."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+        prev: dict = {}
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            ks = self.cs.kernel_stats()
+            f = {
+                "BatchesPerSec": r.get("batches", 0.0),
+                "TxnsPerSec": r.get("txns", 0.0),
+                "ConflictsPerSec": r.get("conflicts", 0.0),
+                "Version": self.version.get(),
+                "OldestVersion": self.cs.oldest_version,
+                "LatencyP99Ms": self.latency.snapshot()["p99"] * 1e3,
+                "KernelBackend": ks["backend"],
+                "KernelBatchesDelta": ks["batches"] - prev.get("batches", 0),
+                "KernelPackMsDelta": ks["pack_ms"] - prev.get("pack_ms", 0.0),
+                "KernelResolveMsDelta":
+                    ks["resolve_ms"] - prev.get("resolve_ms", 0.0),
+                "KernelMergeMsDelta":
+                    ks["merge_ms"] - prev.get("merge_ms", 0.0),
+            }
+            sup = ks.get("supervisor")
+            if sup is not None:
+                f["DeviceState"] = sup["state"]
+                f["DeviceServing"] = sup["serving"]
+                f["DeviceTrips"] = sup["trips"]
+            prev.clear()
+            prev.update(ks)
+            return f
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.stream._process, trace, "ResolverMetrics", fields,
+            interval, TaskPriority.RESOLVER,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         self._flush_pending()  # reply any parked batch before tearing down
         self._task.cancel()
         self._metrics_task.cancel()
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         self.stream.close()
         self.metrics_stream.close()
         self.cs.close()

@@ -16,6 +16,7 @@ from ..rpc.stream import RequestStream
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, Future, Promise, TaskPriority
 from ..runtime.knobs import CoreKnobs
+from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
 
 
 class NotifiedVersion:
@@ -86,6 +87,11 @@ class Sequencer:
         # (pipelined batches retry independently) and gets a fresh version.
         self._evicted_upto: dict[str, int] = {}
         self._cache_cap = 4096
+        self.process = process
+        self.counters = CounterCollection("Sequencer")
+        self.c_requests = self.counters.counter("version_requests")
+        self.c_versions = self.counters.counter("versions_assigned")
+        self._metrics_emitter = None
         self._task = loop.spawn(self._serve(), TaskPriority.GET_LIVE_VERSION, "sequencer")
 
     def _next_version(self) -> Version:
@@ -121,6 +127,11 @@ class Sequencer:
                 continue
             v = self._next_version()
             reply = GetCommitVersionReply(prev_version=self._last_assigned, version=v)
+            self.c_requests.add(1)
+            self.c_versions.add(v - self._last_assigned)
+            for d in req.spans or ():
+                # wire-propagated trace context: the version-assignment hop
+                g_trace_batch.add("MasterServer.getCommitVersion", d)
             self._last_assigned = v
             cache[r.request_num] = reply
             while len(cache) > self._cache_cap:
@@ -135,6 +146,28 @@ class Sequencer:
                 self._evicted_upto[r.requesting_proxy] = max(prev, evicted)
             req.reply(reply)
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic SequencerMetrics emission (version-assignment rates)."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            return {
+                "LastAssigned": self._last_assigned,
+                "MaxCommitted": self._max_committed,
+                "RequestsPerSec": r.get("version_requests", 0.0),
+                "VersionsAssignedPerSec": r.get("versions_assigned", 0.0),
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.process, trace, "SequencerMetrics", fields,
+            interval, TaskPriority.GET_LIVE_VERSION,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         self._task.cancel()
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         self.stream.close()

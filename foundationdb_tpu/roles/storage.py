@@ -39,7 +39,7 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
 from ..runtime.metrics import LatencyTracker
-from ..runtime.trace import g_trace_batch
+from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
 from ..runtime.knobs import CoreKnobs
 
 
@@ -325,6 +325,10 @@ class StorageServer:
         # gets and range reads share one tracker — the storage half of the
         # reference's readLatencyBands
         self.read_latency = LatencyTracker()
+        self.counters = CounterCollection("StorageServer")
+        self.c_reads = self.counters.counter("reads")
+        self.c_mutations = self.counters.counter("mutations_applied")
+        self._metrics_emitter = None
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
         self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
@@ -395,6 +399,7 @@ class StorageServer:
                 live = self._route_fetching(version, muts) if self._fetching else muts
                 for m in live:
                     self.overlay.apply(version, m, self.store.get)
+                self.c_mutations.add(len(live))
                 self.version.set(version)
                 self._fetched = version
                 if self._watches and live:
@@ -659,6 +664,7 @@ class StorageServer:
             req.reply_error(e)
             return
         req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
+        self.c_reads.add(1)
         self.read_latency.observe(self.loop.now() - t0)
         g_trace_batch.add("StorageServer.getValue.Replied", r.debug_id)
 
@@ -732,6 +738,7 @@ class StorageServer:
                 break
         more = len(out) > r.limit
         req.reply(GetKeyValuesReply(out[: r.limit], more))
+        self.c_reads.add(1)
         self.read_latency.observe(self.loop.now() - t0)
 
     def set_tlog_source(
@@ -771,9 +778,36 @@ class StorageServer:
             self.version.rollback(recovery_version)
             self._fetched = recovery_version
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic StorageMetrics emission (the reference's StorageMetrics
+        event): versions, key volume, and read/apply rates."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            return {
+                "Tag": self.tag,
+                "Version": self.version.get(),
+                "DurableVersion": self.durable_version,
+                "KnownCommitted": self.known_committed,
+                "Keys": self.store.key_count(),
+                "ReadsPerSec": r.get("reads", 0.0),
+                "MutationsPerSec": r.get("mutations_applied", 0.0),
+                "ReadP99Ms": self.read_latency.snapshot()["p99"] * 1e3,
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.process, trace, "StorageMetrics", fields,
+            interval, TaskPriority.STORAGE_SERVER, instance=self.tag,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         self.getvalue_stream.close()
         self.getkv_stream.close()
         self.watch_stream.close()

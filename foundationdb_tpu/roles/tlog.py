@@ -41,6 +41,7 @@ from ..rpc.stream import RequestStream
 from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.coverage import testcov
+from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
 from ..runtime.serialize import (
     BinaryReader,
     BinaryWriter,
@@ -152,6 +153,10 @@ class TLog:
             # WRITING_CSTATE).
             self.dq.push(_encode_reset(start_version, known_committed, self._tags))
         self._poppable: dict[str, Version] = {}
+        self.counters = CounterCollection("TLog")
+        self.c_commits = self.counters.counter("commits")
+        self.c_bytes = self.counters.counter("commit_bytes")
+        self._metrics_emitter = None
         self.commit_stream = RequestStream(process, self.WLT_COMMIT, unique=True)
         self.peek_stream = RequestStream(process, self.WLT_PEEK, unique=True)
         self.pop_stream = RequestStream(process, self.WLT_POP, unique=True)
@@ -175,6 +180,10 @@ class TLog:
         r: TLogCommitRequest = req.payload
         if buggify("tlog.drop_push"):
             return  # lost push: the proxy's idempotent retry re-sends it
+        # wire-propagated trace context: the reference's tLogCommit stations
+        spans = req.spans or ()
+        for d in spans:
+            g_trace_batch.add("TLog.tLogCommit.BeforeWaitForVersion", d)
         await maybe_delay(self.loop, "tlog.delay_commit")
         if self.locked:
             return  # locked by recovery: never ack, the old generation ends
@@ -206,16 +215,22 @@ class TLog:
                 return  # predates this epoch: not ours, never ack
             req.reply(r.version)  # raced with a duplicate during the sync
             return
+        commit_bytes = 0
         for tag, muts in r.mutations_by_tag.items():
             self._tags.setdefault(tag, []).append((r.version, muts))
             nb = sum(len(m.key) + len(m.value or b"") for m in muts)
             self._mem_offs.setdefault(tag, []).append((r.version, rec_off, nb))
             self._live_bytes += nb
             self._mem_bytes += nb
+            commit_bytes += nb
+        self.c_commits.add(1)
+        self.c_bytes.add(commit_bytes)
         self.version.set(r.version)
         self.known_committed = max(self.known_committed, r.known_committed)
         if self.dq is not None and self._mem_bytes > self.spill_bytes:
             self._spill()
+        for d in spans:
+            g_trace_batch.add("TLog.tLogCommit.AfterTLogCommit", d)
         req.reply(r.version)
 
     def _spill(self) -> None:
@@ -424,9 +439,35 @@ class TLog:
             for m in muts
         ) + sum(n for sp in self._spilled.values() for _v, _o, n in sp)
 
+    def start_metrics(self, trace, interval: float):
+        """Periodic TLogMetrics emission (rate-converted counters + queue
+        depth — the reference's TLogMetrics event)."""
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.counters.rates(self.loop.now())
+            return {
+                "Version": self.version.get(),
+                "KnownCommitted": self.known_committed,
+                "BytesQueued": self._live_bytes,
+                "SpillEvents": self.spill_events,
+                "Locked": self.locked,
+                "CommitsPerSec": r.get("commits", 0.0),
+                "BytesPerSec": r.get("commit_bytes", 0.0),
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, self.process, trace, "TLogMetrics", fields, interval,
+            TaskPriority.TLOG_COMMIT,
+        )
+        return self._metrics_emitter
+
     def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
         for s in (self.commit_stream, self.peek_stream, self.pop_stream,
                   self.confirm_stream):
             s.close()

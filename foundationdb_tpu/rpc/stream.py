@@ -29,10 +29,17 @@ from .network import Endpoint, NetworkAddress, SimNetwork, SimProcess
 
 @dataclasses.dataclass
 class RpcMessage:
-    """Wire envelope: payload + optional reply endpoint."""
+    """Wire envelope: payload + optional reply endpoint + optional sampled
+    trace context.  `spans` carries the debug IDs of sampled transactions
+    riding this message (the g_traceBatch wire propagation: the receiving
+    process's role code lands its stations in ITS TraceBatch under the
+    originating IDs, so tools/trace_tool.py can join one transaction's
+    journey across OS processes).  None on the un-sampled hot path — the
+    codec keeps the spanless layout byte-identical (zero wire cost)."""
 
     payload: Any
     reply_to: Endpoint | None = None
+    spans: tuple | None = None  # tuple[str, ...] of sampled debug IDs
 
 
 @dataclasses.dataclass
@@ -75,15 +82,19 @@ class ReplyPromise:
 
 
 class ReceivedRequest:
-    """Server-side view of one request: payload + reply capability."""
+    """Server-side view of one request: payload + reply capability +
+    whatever sampled trace spans rode the envelope (role code lands its
+    g_trace_batch stations under them)."""
 
-    __slots__ = ("payload", "_reply_to", "_process", "replied")
+    __slots__ = ("payload", "_reply_to", "_process", "replied", "spans")
 
-    def __init__(self, payload: Any, reply_to: Endpoint | None, process: SimProcess) -> None:
+    def __init__(self, payload: Any, reply_to: Endpoint | None, process: SimProcess,
+                 spans: tuple | None = None) -> None:
         self.payload = payload
         self._reply_to = reply_to
         self._process = process
         self.replied = False
+        self.spans = spans
 
     def reply(self, value: Any = None) -> None:
         self.replied = True
@@ -120,7 +131,12 @@ class RequestStream:
         return Endpoint(self._process.address, self._token)
 
     def _on_message(self, msg: RpcMessage) -> None:
-        self.requests.send(ReceivedRequest(msg.payload, msg.reply_to, self._process))
+        self.requests.send(
+            ReceivedRequest(
+                msg.payload, msg.reply_to, self._process,
+                getattr(msg, "spans", None),
+            )
+        )
 
     def next(self) -> Future:
         """Future of the next ReceivedRequest."""
@@ -135,7 +151,12 @@ def _register_rpc_codec() -> None:
     """RpcMessage's wire codec (runtime/serialize.py registry): reply
     endpoint + nested payload through `encode_any`, so a registered hot
     payload stays binary end to end and an exotic one degrades to a
-    counted pickle body — never a whole-frame pickle."""
+    counted pickle body — never a whole-frame pickle.
+
+    Two layouts, one type: tag 60 is the spanless envelope (byte-identical
+    to the pre-tracing wire — an un-sampled message costs ZERO extra
+    bytes), tag 61 prefixes the same body with the sampled debug-ID spans
+    (`u16 n + n × (u16 len + utf8)`)."""
     import struct as _struct
 
     from ..runtime import serialize as _wire
@@ -143,7 +164,7 @@ def _register_rpc_codec() -> None:
     _ST_I = _struct.Struct("<I")
     _ST_H = _struct.Struct("<H")
 
-    def enc(o: RpcMessage, stats, strict) -> bytes:
+    def _enc_envelope(o: RpcMessage, stats, strict) -> bytes:
         rt = o.reply_to
         if rt is not None and rt.address is None:
             # the decoder keys the token read off the address flag, so an
@@ -162,8 +183,21 @@ def _register_rpc_codec() -> None:
         parts.append(body)
         return b"".join(parts)
 
-    def dec(buf: bytes, stats) -> RpcMessage:
-        addr, pos = _wire.read_addr(buf, 0)
+    def enc(o: RpcMessage, stats, strict):
+        body = _enc_envelope(o, stats, strict)
+        sp = o.spans
+        if not sp:
+            return body  # tag 60: the spanless wire, unchanged
+        parts = [_ST_H.pack(len(sp))]
+        for s in sp:
+            sb = s.encode("utf-8")
+            parts.append(_ST_H.pack(len(sb)))
+            parts.append(sb)
+        parts.append(body)
+        return 61, b"".join(parts)
+
+    def _dec_envelope(buf: bytes, pos: int, stats, spans) -> RpcMessage:
+        addr, pos = _wire.read_addr(buf, pos)
         reply_to = None
         if addr is not None:
             (ntok,) = _ST_I.unpack_from(buf, pos)
@@ -172,9 +206,29 @@ def _register_rpc_codec() -> None:
             pos += ntok
             reply_to = Endpoint(addr, token)
         (tag,) = _ST_H.unpack_from(buf, pos)
-        return RpcMessage(_wire.decode_any(tag, buf[pos + 2 :], stats), reply_to)
+        return RpcMessage(
+            _wire.decode_any(tag, buf[pos + 2 :], stats), reply_to, spans
+        )
+
+    def dec(buf: bytes, stats) -> RpcMessage:
+        return _dec_envelope(buf, 0, stats, None)
+
+    def dec_spanned(buf: bytes, stats) -> RpcMessage:
+        (n,) = _ST_H.unpack_from(buf, 0)
+        pos = 2
+        spans = []
+        for _ in range(n):
+            (ln,) = _ST_H.unpack_from(buf, pos)
+            pos += 2
+            sb = buf[pos : pos + ln]
+            if len(sb) != ln:
+                raise _wire.CodecError("truncated span id")
+            spans.append(sb.decode("utf-8"))
+            pos += ln
+        return _dec_envelope(buf, pos, stats, tuple(spans))
 
     _wire.register_codec(60, RpcMessage, enc, dec)
+    _wire.register_decoder(61, dec_spanned)
 
 
 _register_rpc_codec()
@@ -188,14 +242,18 @@ class RequestStreamRef:
         self._process = process
         self.endpoint = endpoint
 
-    def send(self, payload: Any) -> None:
+    def send(self, payload: Any, spans: tuple | None = None) -> None:
         """One-way, at-most-once (FlowTransport unreliable send)."""
-        self._net.send(self._process.address, self.endpoint, RpcMessage(payload))
+        self._net.send(
+            self._process.address, self.endpoint, RpcMessage(payload, None, spans)
+        )
 
-    def get_reply(self, payload: Any, timeout: float | None = None) -> Future:
+    def get_reply(self, payload: Any, timeout: float | None = None,
+                  spans: tuple | None = None) -> Future:
         rp = ReplyPromise(self._process)
         self._net.send(
-            self._process.address, self.endpoint, RpcMessage(payload, rp.endpoint)
+            self._process.address, self.endpoint,
+            RpcMessage(payload, rp.endpoint, spans),
         )
         if timeout is None:
             return rp.future

@@ -116,6 +116,18 @@ class CoreKnobs(Knobs):
         self.init("DEVICE_MAX_BACKOFF", 5.0)
         self.init("DEVICE_REPROBE_INTERVAL", 5.0 if r is None else 1.0 + r.random() * 8.0)
 
+        # trace plane (docs/OBSERVABILITY.md "Distributed tracing"): the
+        # TraceEvent file/ring discipline.  TRACE_SEVERITY drops events
+        # below it entirely (the reference's --trace severity floor);
+        # TRACE_ROLL_SIZE / TRACE_MAX_LOGS bound the rolling per-process
+        # trace files (--maxlogssize / --maxlogs analogs); every role
+        # emits its rate-converted `*Metrics` event each METRICS_INTERVAL
+        # (flow/Stats.h traceCounters cadence)
+        self.init("TRACE_SEVERITY", 5)
+        self.init("TRACE_ROLL_SIZE", 10 << 20)
+        self.init("TRACE_MAX_LOGS", 10)
+        self.init("METRICS_INTERVAL", 5.0)
+
         # commit-plane wire (docs/WIRE.md): transport write coalescing.
         # Queued frames flush once per reactor tick, or immediately once a
         # connection's queue passes WIRE_FLUSH_BYTES (bounds both memory

@@ -28,7 +28,12 @@ from typing import Any, Callable, Iterable
 # frames) — a breaking bump.  The transport stamps this into its hello
 # frame and severs a mismatched peer with a traced reason; the TLog's
 # durable _R_RESET record, by contrast, kept a legacy decode path.
-PROTOCOL_VERSION = 0x0F_DB_71_01
+# ..02: the span-carrying RpcMessage envelope (tag 61, the distributed
+# tracing plane).  Low-byte bump: the spanless wire is unchanged, but a
+# pre-tracing peer cannot decode sampled traffic, and the EXACT-match
+# hello means the pair severs once with a traced TransportProtocolMismatch
+# instead of looping on per-message decode failures when sampling turns on.
+PROTOCOL_VERSION = 0x0F_DB_71_02
 
 
 class BinaryWriter:
@@ -206,7 +211,12 @@ def register_codec(tag: int, cls: type, enc: Callable, dec: Callable) -> None:
     ValueError/struct.error/IndexError on corruption — decode_payload
     normalizes those to CodecError).  Dispatch is on EXACT type: a
     subclass of a registered message falls back to pickle rather than
-    silently truncating its extra state."""
+    silently truncating its extra state.
+
+    An encoder may instead return `(tag, bytes)` to pick between layouts
+    for the same type — the zero-cost-optional-field pattern: RpcMessage
+    keeps its spanless layout byte-identical under this tag and routes
+    span-carrying envelopes to a `register_decoder` tag."""
     if tag < 16:
         raise ValueError(f"tags 0-15 are reserved (got {tag})")
     prev = _ENC_BY_TYPE.get(cls)
@@ -215,6 +225,16 @@ def register_codec(tag: int, cls: type, enc: Callable, dec: Callable) -> None:
     if tag in _DEC_BY_TAG and (prev is None or prev[0] != tag):
         raise ValueError(f"tag {tag} already in use")
     _ENC_BY_TYPE[cls] = (tag, enc, dec)
+    _DEC_BY_TAG[tag] = dec
+
+
+def register_decoder(tag: int, dec: Callable) -> None:
+    """Register a decode-only tag: the alternate-layout half of an encoder
+    that returns `(tag, body)` (see register_codec)."""
+    if tag < 16:
+        raise ValueError(f"tags 0-15 are reserved (got {tag})")
+    if tag in _DEC_BY_TAG:
+        raise ValueError(f"tag {tag} already in use")
     _DEC_BY_TAG[tag] = dec
 
 
@@ -256,7 +276,10 @@ def encode_any(obj: Any, stats=None, strict: bool = False) -> tuple[int, bytes]:
     if entry is not None:
         tag, enc, _dec = entry
         try:
-            return tag, enc(obj, stats, strict)
+            out = enc(obj, stats, strict)
+            # an encoder may pick an alternate layout by returning its own
+            # (tag, body) — the optional-field pattern (register_codec doc)
+            return out if type(out) is tuple else (tag, out)
         except Exception as e:  # noqa: BLE001 — downgrade, don't crash sends
             if strict:
                 raise e if isinstance(e, Unencodable) else Unencodable(repr(e))

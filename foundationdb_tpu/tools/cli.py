@@ -29,6 +29,9 @@ commands:
   status [json]               cluster status summary (or full json)
   sample <rate>               sample a fraction of txns into the timeline
   timeline [id]               sampled-transaction station report(s)
+  tracetool <path> [args...]  analyze rolling trace files (cross-process
+                              timeline joins, --slow N, --histogram,
+                              --series TYPE:FIELD, --id DEBUG_ID)
   configure k=v ...           change role counts (n_tlogs/n_proxies/n_resolvers)
   exclude <target> ...        drain + ban machines/processes (ManagementAPI)
   include [target ...]        re-admit targets (none = all)
@@ -168,6 +171,16 @@ class Cli:
                 f"{r['total_s'] * 1e3:.3f} ms)"
                 for r in reports
             )
+        if cmd == "tracetool":
+            # offline trace-file analysis (tools/trace_tool.py): joins
+            # cross-process timelines by debug ID, histograms, series
+            from .trace_tool import run_report
+
+            try:
+                return run_report(args)
+            except SystemExit:  # argparse error must not kill the REPL
+                return ("usage: tracetool <path>... [--slow N] [--id ID] "
+                        "[--histogram] [--series TYPE:FIELD] [--json OUT]")
         if cmd == "configure":
             # configure n_tlogs=3 n_proxies=2 ... (ManagementAPI changeConfig)
             from ..client.management import configure

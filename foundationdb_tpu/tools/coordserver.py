@@ -33,14 +33,32 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--ip", default="127.0.0.1")
     ap.add_argument("--run-seconds", type=float, default=None)
+    ap.add_argument("--trace-file", default=None,
+                    help="base path for rolling trace files "
+                         "(<path>.<seq>.jsonl): wire errors + periodic "
+                         "WireMetrics from this coordinator process")
     args = ap.parse_args(argv)
 
     from ..control.coordination import Coordinator
     from ..rpc.transport import NetDriver, RealNetwork
     from ..runtime.core import EventLoop
+    from ..runtime.knobs import CoreKnobs
+    from ..runtime.trace import TraceCollector, TraceFileSink, spawn_wire_metrics
 
     loop = EventLoop()
-    net = RealNetwork(loop, name="coordinator", ip=args.ip, port=args.port)
+    knobs = CoreKnobs()
+    sink = None
+    trace = None
+    if args.trace_file:
+        sink = TraceFileSink(args.trace_file, roll_size=knobs.TRACE_ROLL_SIZE,
+                             max_logs=knobs.TRACE_MAX_LOGS)
+        trace = TraceCollector(clock=loop.now, sink=sink,
+                               min_severity=knobs.TRACE_SEVERITY)
+    net = RealNetwork(loop, name="coordinator", ip=args.ip, port=args.port,
+                      trace=trace)
+    if trace is not None:
+        trace.machine = f"coord:{net.address.port}"
+        spawn_wire_metrics(loop, trace, net.wire, knobs.METRICS_INTERVAL, "tcp")
     Coordinator(net.process, loop)  # cluster-state register
     Coordinator(net.process, loop, tokens=LEADER_TOKENS)  # leader register
     print(f"coordinator ready on {net.address.ip}:{net.address.port}", flush=True)
@@ -50,6 +68,8 @@ def main(argv=None) -> None:
         pass
     finally:
         net.close()
+        if sink is not None:
+            sink.close()
 
 
 if __name__ == "__main__":

@@ -27,7 +27,9 @@ Ops (body → reply body):
    10 ATOMIC_ADD   u64, key, i64 delta         → ()
    11 GET_READ_VERSION u64                     → i64 version
    13 SET_OPTION   u64, option                 → ()   (transaction option by
-                                                 name, e.g. lock_aware)
+                                                 name, e.g. lock_aware, or
+                                                 name=value for valued options
+                                                 like debug_transaction_identifier)
    14 WATCH        u64, key                    → i64 version (replies when
                                                  the key's value CHANGES —
                                                  fdb_transaction_watch; use a
@@ -121,9 +123,10 @@ class ClientGateway:
     on the cluster's event loop."""
 
     def __init__(self, loop: EventLoop, db, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, trace=None) -> None:
         self.loop = loop
         self.db = db
+        self.trace = trace  # optional TraceCollector: connection events
         self._sel = selectors.DefaultSelector()
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -145,6 +148,11 @@ class ClientGateway:
                 s.setblocking(False)
                 conn = _GwConn(s)
                 self._sel.register(s, selectors.EVENT_READ, conn)
+                if self.trace is not None:
+                    self.trace.trace(
+                        "GatewayConnectionOpened",
+                        Peer=str(s.getpeername()),
+                    )
                 continue
             conn: _GwConn = key.data
             try:
@@ -175,6 +183,8 @@ class ClientGateway:
         if conn.closed:
             return
         conn.closed = True
+        if self.trace is not None:
+            self.trace.trace("GatewayConnectionClosed", Txns=len(conn.txns))
         try:
             self._sel.unregister(conn.sock)
         except KeyError:
@@ -269,11 +279,14 @@ class ClientGateway:
                 elif op == 11:  # GET_READ_VERSION
                     v = await tr.get_read_version()
                     out += struct.pack("<q", v)
-                elif op == 13:  # SET_OPTION
+                elif op == 13:  # SET_OPTION ("name" or "name=value": the
+                    # valued options — debug_transaction_identifier carries
+                    # the client's sampled debug ID into the trace plane)
                     name, off = _bstr(body, off)
+                    opt, _, value = name.partition(b"=")
                     try:
-                        tr.set_option(name)
-                    except ValueError:
+                        tr.set_option(opt, value or None)
+                    except (ValueError, TypeError):
                         status = ERR_BAD_REQUEST
                 elif op == 14:  # WATCH (db-level: replies when key changes)
                     k, off = _bstr(body, off)

@@ -29,7 +29,22 @@ def main(argv=None) -> None:
     ap.add_argument("--engine", choices=("memory", "ssd"), default="ssd")
     ap.add_argument("--workers", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--trace-file", default=None,
+                    help="base path for ROLLING per-process trace files "
+                         "(<path>.<seq>.jsonl — the reference's rolling "
+                         "trace files); events stream line-buffered so a "
+                         "crash loses at most one line")
+    ap.add_argument("--trace-roll-size", type=int, default=None,
+                    help="bytes per trace file before rolling "
+                         "(TRACE_ROLL_SIZE knob; --maxlogssize analog)")
+    ap.add_argument("--trace-max-logs", type=int, default=None,
+                    help="rolled generations kept (TRACE_MAX_LOGS knob)")
+    ap.add_argument("--trace-severity", type=int, default=None,
+                    help="drop trace events below this severity "
+                         "(TRACE_SEVERITY knob)")
+    ap.add_argument("--metrics-interval", type=float, default=None,
+                    help="seconds between per-role *Metrics trace events "
+                         "(METRICS_INTERVAL knob)")
     ap.add_argument("--timeline-file", default=None,
                     help="scrape endpoint for sampled-transaction pipeline "
                          "timelines: rewrite this file with the "
@@ -50,9 +65,24 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     from ..control.recoverable import RecoverableCluster
+    from ..runtime.knobs import CoreKnobs
+    from ..runtime.trace import TraceFileSink
     from .gateway import ClientGateway, GatewayDriver
 
-    sink = open(args.trace_file, "a") if args.trace_file else None
+    knobs = CoreKnobs()
+    if args.trace_roll_size is not None:
+        knobs.TRACE_ROLL_SIZE = args.trace_roll_size
+    if args.trace_max_logs is not None:
+        knobs.TRACE_MAX_LOGS = args.trace_max_logs
+    if args.trace_severity is not None:
+        knobs.TRACE_SEVERITY = args.trace_severity
+    if args.metrics_interval is not None:
+        knobs.METRICS_INTERVAL = args.metrics_interval
+    sink = (
+        TraceFileSink(args.trace_file, roll_size=knobs.TRACE_ROLL_SIZE,
+                      max_logs=knobs.TRACE_MAX_LOGS)
+        if args.trace_file else None
+    )
     rnet = None
     extra = {}
     leader_cs = None
@@ -95,6 +125,7 @@ def main(argv=None) -> None:
         storage_engine=args.engine,
         n_workers=args.workers,
         trace_sink=sink,
+        knobs=knobs,
         **extra,
     )
     if rnet is not None:
@@ -102,10 +133,19 @@ def main(argv=None) -> None:
         # cluster's trace stream; the collector only exists post-assembly,
         # and the transport reads the attribute at event time
         rnet.trace = cluster.trace
+        # the REAL transport's WireStats deltas join the metrics plane too
+        from ..runtime.trace import spawn_wire_metrics
+
+        spawn_wire_metrics(
+            cluster.loop, cluster.trace, rnet.wire,
+            knobs.METRICS_INTERVAL, "tcp",
+        )
     db = cluster.database()
     if args.sample_rate > 0:
         db.debug_sample_rate = args.sample_rate
-    gw = ClientGateway(cluster.loop, db, port=args.port)
+    gw = ClientGateway(cluster.loop, db, port=args.port, trace=cluster.trace)
+    # host attribution for cross-process trace joins (trace_tool)
+    cluster.trace.machine = f"server:{gw.port}"
     if args.timeline_file:
         # the ops scrape surface: atomically rewrite the dump on a cadence
         # so a file-watching collector always reads a complete document

@@ -21,25 +21,18 @@ from __future__ import annotations
 from typing import Any
 
 from ..runtime.trace import TraceBatch, g_trace_batch
+from .trace_tool import report_from_stations, role_of
 
 
 def _report_from_events(debug_id: str, events: list[dict[str, Any]]) -> dict[str, Any]:
-    """Build one report from a transaction's TIME-SORTED events."""
-    stations: list[dict[str, Any]] = []
-    prev: float | None = None
-    for e in events:
-        stations.append({
-            "location": e["Location"],
-            "time": e["Time"],
-            "delta": 0.0 if prev is None else e["Time"] - prev,
-        })
-        prev = e["Time"]
-    return {
-        "id": debug_id,
-        "station_count": len(stations),
-        "total_s": stations[-1]["time"] - stations[0]["time"] if stations else 0.0,
-        "stations": stations,
-    }
+    """Build one report from a transaction's TIME-SORTED events — a thin
+    consumer of trace_tool's join (the same report shape in-memory that
+    trace_tool builds from cross-process trace files)."""
+    return report_from_stations(debug_id, [
+        {"location": e["Location"], "time": e["Time"],
+         "role": role_of(e["Location"])}
+        for e in events
+    ])
 
 
 def _grouped(tb: TraceBatch) -> dict[str, list[dict[str, Any]]]:

@@ -541,7 +541,7 @@ class TestFailoverDrain:
                 finally:
                     self._racer_inflight = False
 
-            async def stalled(self, ref, payload, deadline):
+            async def stalled(self, ref, payload, deadline, **kw):
                 if (
                     isinstance(payload, GetCommitVersionRequest)
                     and getattr(self, "_racer_inflight", False)
@@ -549,7 +549,7 @@ class TestFailoverDrain:
                 ):
                     state["armed"] = False
                     await self.loop.delay(1.0)
-                return await orig(self, ref, payload, deadline)
+                return await orig(self, ref, payload, deadline, **kw)
 
             CommitProxy._commit_batch_inner = tagged_inner
 

@@ -209,6 +209,11 @@ BUILDERS = {
         None
         if r.random() < 0.3
         else Endpoint(NetworkAddress("10.0.0.%d" % r.randrange(9), 4500), "rp:" + _rstr(r)),
+        # sampled trace spans (never an empty tuple: the wire normalizes
+        # "no spans" to None, the zero-cost tag-60 layout)
+        None
+        if r.random() < 0.5
+        else tuple("dbg-" + _rstr(r) for _ in range(r.randrange(1, 4))),
     ),
 }
 

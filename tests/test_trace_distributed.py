@@ -1,0 +1,174 @@
+"""Distributed tracing on the REAL TCP fabric: two OS processes — a
+tools/server.py cluster rolling its own trace files, and this test process
+as a gateway-protocol client rolling ITS own — with sampling on.  A
+sampled transaction's debug ID rides the gateway SET_OPTION into the
+server, its pipeline stations land in the server's rolled trace files,
+the client's commit stations land in the client's file, and
+tools/trace_tool.py joins the journey back together BY DEBUG ID across
+files, with monotone wall-clock station times and role attribution
+spanning >= 3 roles (docs/OBSERVABILITY.md "Distributed tracing")."""
+
+from __future__ import annotations
+
+import glob
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "PALLAS_AXON_POOL_IPS": "",  # skip the TPU-tunnel plugin: CPU-only procs
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+class Proc:
+    def __init__(self, *mod_args: str) -> None:
+        self.p = subprocess.Popen(
+            [sys.executable, "-m", *mod_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=ENV, cwd=REPO,
+        )
+        self.lines: queue.Queue[str] = queue.Queue()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self) -> None:
+        for line in self.p.stdout:
+            self.lines.put(line)
+
+    def wait_line(self, needle: str, timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                line = self.lines.get(timeout=0.5)
+            except queue.Empty:
+                if self.p.poll() is not None:
+                    raise RuntimeError(
+                        f"process exited rc={self.p.returncode} before {needle!r}"
+                    )
+                continue
+            if needle in line:
+                return line
+        raise TimeoutError(f"never saw {needle!r}")
+
+    def kill(self) -> None:
+        self.p.kill()
+        self.p.wait()
+
+
+def test_trace_join_across_os_processes(tmp_path):
+    from foundationdb_tpu.client.gateway_client import GatewayClient, GatewayError
+    from foundationdb_tpu.runtime.trace import (
+        TraceCollector,
+        TraceFileSink,
+        g_trace_batch,
+    )
+    from foundationdb_tpu.tools import trace_tool
+
+    server_base = str(tmp_path / "server-trace")
+    server = None
+    gc = None
+    try:
+        server = Proc(
+            "foundationdb_tpu.tools.server",
+            "--shards", "1", "--replication", "1", "--workers", "0",
+            "--engine", "memory",
+            "--sample-rate", "1.0",
+            "--trace-file", server_base,
+            "--trace-roll-size", "1500",   # tiny: force real rolling
+            "--trace-max-logs", "50",
+            "--metrics-interval", "0.5",
+            "--run-seconds", "240",
+        )
+        line = server.wait_line("fdbtpu server ready on", timeout=120.0)
+        port = int(line.strip().rsplit(":", 1)[1])
+
+        # the CLIENT process's own trace plane: wall clock + rolling file,
+        # so the joined timeline crosses two processes' files
+        client_sink = TraceFileSink(str(tmp_path / "client-trace"),
+                                    roll_size=1 << 20)
+        client_trace = TraceCollector(clock=time.time, sink=client_sink,
+                                      machine="client-proc")
+        g_trace_batch.attach_clock(time.time, client_trace)
+
+        gc = GatewayClient("127.0.0.1", port, timeout=30.0)
+        done_id = None
+        for attempt in range(10):
+            did = f"e2e-span-{attempt}"
+            tr = gc.transaction()
+            try:
+                tr.set_debug_id(did)
+                tr.set(b"dk%d" % attempt, b"dv")
+                tr.commit()
+                done_id = did
+                break
+            except GatewayError:
+                continue  # retryable commit failure: fresh txn, fresh id
+            finally:
+                tr.destroy()
+        assert done_id is not None, "no sampled transaction ever committed"
+
+        # volume so the server's tiny roll size actually rolls: more
+        # sampled commits + half a metrics interval's periodic events
+        for i in range(10):
+            tr = gc.transaction()
+            try:
+                tr.set_debug_id(f"fill-{i}")
+                tr.set(b"fk%d" % i, b"fv")
+                tr.commit()
+            except GatewayError:
+                pass
+            finally:
+                tr.destroy()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if len(glob.glob(server_base + ".*.jsonl")) >= 2:
+                break
+            time.sleep(0.25)
+        server_files = sorted(glob.glob(server_base + ".*.jsonl"))
+        assert len(server_files) >= 2, (
+            f"server trace files never rolled: {server_files}"
+        )
+    finally:
+        if gc is not None:
+            gc.close()
+        if server is not None:
+            server.kill()
+        # detach the test-process trace plane (don't leak the wall clock
+        # into later tests' deterministic timelines)
+        g_trace_batch.attach_clock(lambda: 0.0)
+
+    # -- the offline join over BOTH processes' rolled files ------------------
+    events = trace_tool.load_events([str(tmp_path)])
+    joined = trace_tool.join_timelines(events)
+    assert done_id in joined, f"debug id {done_id} not in any trace file"
+    rep = trace_tool.report_from_stations(done_id, joined[done_id])
+
+    # >= 3 roles crossed, >= 2 trace files (the client's + the server's)
+    assert len(rep["roles"]) >= 3, rep["roles"]
+    assert {"client", "proxy"} <= set(rep["roles"]), rep["roles"]
+    srcs = {s.split(".")[0] for s in rep["sources"]}
+    assert {"client-trace", "server-trace"} <= srcs, rep["sources"]
+
+    # monotone per-station times on the SHARED wall clock: the client's
+    # commit brackets the server-side pipeline despite different processes
+    times = [s["time"] for s in rep["stations"]]
+    assert times == sorted(times)
+    assert all(s["delta"] >= 0 for s in rep["stations"])
+    locs = [s["location"] for s in rep["stations"]]
+    assert locs[0] == "GatewayClient.commit.Before", locs
+    assert locs[-1] == "GatewayClient.commit.After", locs
+    for want in ("CommitProxyServer.commitBatch.Before",
+                 "Resolver.resolveBatch.After",
+                 "TLog.tLogCommit.AfterTLogCommit"):
+        assert want in locs, locs
+    # host attribution: both machine identities appear on the journey
+    machines = {s.get("machine") for s in rep["stations"]}
+    assert "client-proc" in machines
+    assert any(m and m.startswith("server:") for m in machines), machines

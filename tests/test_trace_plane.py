@@ -1,0 +1,472 @@
+"""Distributed commit-path tracing plane (docs/OBSERVABILITY.md
+"Distributed tracing"): flight-recorder ring retention, rate-converted
+counters, severity filtering + rolling trace files, wire-propagated spans,
+the periodic per-role `*Metrics` emission, the trace_tool join, the WARN+
+event-type guard, and the sampling-off overhead contract."""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import pathlib
+import time
+
+from foundationdb_tpu.cluster import SimCluster
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.control.status import (
+    ROLE_METRICS_SCHEMA,
+    validate_metrics_event,
+)
+from foundationdb_tpu.runtime.knobs import CoreKnobs
+from foundationdb_tpu.runtime.trace import (
+    SEV_DEBUG,
+    SEV_WARN,
+    CounterCollection,
+    TraceCollector,
+    TraceFileSink,
+    WARN_EVENT_TYPES,
+    g_trace_batch,
+)
+
+
+# -- satellite: flight-recorder retention ------------------------------------
+
+
+def test_trace_collector_ring_keeps_newest():
+    """A flight recorder keeps the NEWEST events: the ring overwrites the
+    oldest, and count() still reports every event ever traced."""
+    tc = TraceCollector(keep=5)
+    for i in range(12):
+        tc.trace("RingEv", I=i)
+    assert tc.count("RingEv") == 12
+    assert len(tc.events) == 5
+    assert [e["I"] for e in tc.find("RingEv")] == [7, 8, 9, 10, 11]
+    # a different type interleaved still counts correctly after overwrite
+    tc.trace("OtherEv")
+    assert tc.count("OtherEv") == 1
+    assert tc.count("RingEv") == 12
+    assert len(tc.events) == 5  # ring bound holds
+
+
+def test_trace_severity_filter():
+    """Events below TRACE_SEVERITY are dropped entirely (ring, latest,
+    count) — the reference's --trace severity floor."""
+    tc = TraceCollector(min_severity=SEV_WARN)
+    tc.trace("Quiet", severity=SEV_DEBUG, track_latest="q")
+    tc.trace("Loud", severity=SEV_WARN, track_latest="l")
+    assert tc.count("Quiet") == 0 and not tc.find("Quiet")
+    assert "q" not in tc.latest
+    assert tc.count("Loud") == 1 and "l" in tc.latest
+
+
+# -- satellite: rate-converted counters --------------------------------------
+
+
+def test_counter_collection_rates():
+    """rates() reports per-second deltas against the remembered previous
+    snapshot (Counter::getRate) — not lifetime totals."""
+    cc = CounterCollection("T")
+    a = cc.counter("a")
+    b = cc.counter("b")
+    a.add(100)
+    assert cc.rates(10.0) == {"a": 0.0, "b": 0.0}  # first call arms
+    a.add(30)
+    b.add(4)
+    r = cc.rates(12.0)
+    assert r == {"a": 15.0, "b": 2.0}
+    r2 = cc.rates(13.0)  # nothing moved since the last call
+    assert r2 == {"a": 0.0, "b": 0.0}
+    # snapshot() still reports absolute values
+    assert cc.snapshot() == {"a": 130, "b": 4}
+
+
+# -- rolling trace files -----------------------------------------------------
+
+
+def test_trace_file_rolling(tmp_path):
+    """TRACE_ROLL_SIZE/TRACE_MAX_LOGS analogs: files roll by size, old
+    generations are deleted, and every line is complete JSON (line-buffered
+    crash-safe flush)."""
+    base = str(tmp_path / "trace")
+    sink = TraceFileSink(base, roll_size=400, max_logs=2)
+    tc = TraceCollector(sink=sink, machine="m0")
+    for i in range(40):
+        tc.trace("RollEv", I=i, Pad="x" * 50)
+    files = sink.files()
+    assert len(files) >= 2, "expected the sink to roll"
+    assert len(files) <= 2, "max_logs must bound retained generations"
+    # the oldest generation was deleted
+    assert not os.path.exists(base + ".0.jsonl")
+    seen = []
+    for f in files:
+        for line in open(f):
+            ev = json.loads(line)  # complete JSON on every line
+            assert ev["Machine"] == "m0"
+            assert "WallTime" in ev  # the cross-process join clock
+            seen.append(ev["I"])
+    assert seen == sorted(seen)
+    assert seen[-1] == 39  # the newest event survived the rolls
+    sink.close()
+
+
+def test_trace_file_sink_resumes_after_pruned_run(tmp_path):
+    """A restarted process must resume ABOVE the previous run's newest
+    generation even when pruning deleted the low sequence numbers — not
+    re-open seq 0 and later append into the old run's surviving files."""
+    base = str(tmp_path / "trace")
+    s1 = TraceFileSink(base, roll_size=80, max_logs=2)
+    for i in range(30):
+        s1.write(json.dumps({"I": i}) + "\n")
+    s1.close()
+    survivors = sorted(s1.files())
+    assert len(survivors) == 2 and not os.path.exists(base + ".0.jsonl")
+    prev_max = max(int(f.rsplit(".", 2)[1]) for f in survivors)
+
+    s2 = TraceFileSink(base, roll_size=80, max_logs=2)
+    s2.write(json.dumps({"I": "restart"}) + "\n")
+    s2.close()
+    assert s2.current_file == f"{base}.{prev_max + 1}.jsonl"
+    # the old run's files were not touched
+    for f in survivors:
+        assert all(json.loads(l)["I"] != "restart" for l in open(f))
+
+
+# -- wire-propagated spans ---------------------------------------------------
+
+
+def test_rpc_envelope_spans_codec():
+    """The RpcMessage codec: spanless envelopes keep tag 60 (zero extra
+    bytes on the un-sampled path); span-carrying ones ride tag 61 and
+    round-trip exactly."""
+    import struct
+
+    from foundationdb_tpu.rpc.stream import RpcMessage
+    from foundationdb_tpu.runtime import serialize as wire
+
+    plain = RpcMessage(b"payload")
+    blob = wire.encode_payload(plain)
+    assert struct.unpack_from("<H", blob, 0)[0] == 60
+    assert wire.decode_payload(blob) == plain
+
+    spanned = RpcMessage(b"payload", None, ("dbg-1", "dbg-2"))
+    blob2 = wire.encode_payload(spanned)
+    assert struct.unpack_from("<H", blob2, 0)[0] == 61
+    back = wire.decode_payload(blob2)
+    assert back == spanned and back.spans == ("dbg-1", "dbg-2")
+    # the span prefix costs exactly its own bytes: the envelope after it
+    # is byte-identical to the spanless layout
+    assert blob2.endswith(blob[2:])
+
+
+def test_sampled_commit_spans_cross_roles():
+    """A sampled transaction's debug ID propagates through the RpcMessage
+    envelope into the resolver, TLog, and sequencer stations — the
+    stations the in-process proxy loop cannot emit for them."""
+    c = SimCluster(seed=31, n_resolvers=2, n_tlogs=2)
+    g_trace_batch.attach_clock(c.loop.now, c.trace)
+    db = c.database()
+    db.debug_sample_rate = 1.0
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"span", b"1")
+        await tr.commit()
+        return tr.debug_id
+
+    did = c.run_until(c.loop.spawn(main()), 60.0)
+    assert did is not None
+    locs = [e["Location"] for e in g_trace_batch.timeline(did)]
+    for want in (
+        "MasterServer.getCommitVersion",
+        "Resolver.resolveBatch.Before",
+        "Resolver.resolveBatch.AfterOrderer",
+        "Resolver.resolveBatch.After",
+        "TLog.tLogCommit.BeforeWaitForVersion",
+        "TLog.tLogCommit.AfterTLogCommit",
+    ):
+        assert want in locs, f"missing {want}: {locs}"
+    # causal order across the hops the envelope carried the ID over
+    order = [locs.index(x) for x in (
+        "CommitProxyServer.commitBatch.Before",
+        "MasterServer.getCommitVersion",
+        "Resolver.resolveBatch.Before",
+        "Resolver.resolveBatch.After",
+        "CommitProxyServer.commitBatch.AfterResolution",
+        "TLog.tLogCommit.BeforeWaitForVersion",
+        "TLog.tLogCommit.AfterTLogCommit",
+        "CommitProxyServer.commitBatch.AfterLogPush",
+    )]
+    assert order == sorted(order), locs
+    # every station also landed in the cluster collector as
+    # TransactionDebug (the trace-FILE surface trace_tool joins)
+    td = [e for e in c.trace.find("TransactionDebug") if e["ID"] == did]
+    assert {e["Location"] for e in td} == set(locs)
+    c.stop()
+
+
+def test_unsampled_commit_rides_spanless_envelopes():
+    """Sampling off: no envelope carries spans (the zero-cost contract)
+    and no station events are emitted."""
+    c = SimCluster(seed=32)
+    g_trace_batch.attach_clock(c.loop.now, c.trace)
+    db = c.database()
+
+    async def main():
+        tr = db.create_transaction()
+        tr.set(b"q", b"1")
+        await tr.commit()
+
+    c.run_until(c.loop.spawn(main()), 60.0)
+    assert g_trace_batch.events == []
+    assert not c.trace.find("TransactionDebug")
+    c.stop()
+
+
+# -- periodic per-role metrics ----------------------------------------------
+
+
+def test_every_role_emits_metrics_within_one_interval():
+    """Acceptance: every role type emits its `*Metrics` event within one
+    METRICS_INTERVAL, with the schema'd fields (ROLE_METRICS_SCHEMA)."""
+    knobs = CoreKnobs()
+    knobs.METRICS_INTERVAL = 0.5
+    c = RecoverableCluster(
+        seed=77, n_storage_shards=1, storage_replication=1,
+        knobs=knobs, remote_region=True,
+    )
+    db = c.database()
+
+    async def main():
+        for i in range(8):
+            tr = db.create_transaction()
+            tr.set(b"mm%02d" % i, b"v")
+            await tr.commit()
+        tr = db.create_transaction()
+        await tr.get(b"mm00")
+        # one full interval beyond the workload so every emitter fires
+        await c.loop.delay(0.6)
+
+    c.run_until(c.loop.spawn(main()), 300)
+    for etype in ("ProxyMetrics", "ResolverMetrics", "TLogMetrics",
+                  "StorageMetrics", "SequencerMetrics", "LogRouterMetrics",
+                  "WireMetrics"):
+        evs = c.trace.find(etype)
+        assert evs, f"no {etype} emitted"
+        for ev in evs:
+            validate_metrics_event(ev)
+    # rates are real rates: after the workload some proxy interval saw
+    # committed transactions per second, and the sim fabric moved frames
+    assert any(
+        e["TxnsCommittedPerSec"] > 0 for e in c.trace.find("ProxyMetrics")
+    )
+    assert any(
+        e["FramesEncodedPerSec"] > 0 for e in c.trace.find("WireMetrics")
+    )
+    assert any(e["TxnsPerSec"] > 0 for e in c.trace.find("ResolverMetrics"))
+    # track_latest: status's latest_events holds the newest sample per role
+    assert any(k.startswith("ProxyMetrics:") for k in c.trace.latest)
+    c.stop()
+
+
+def test_metrics_events_are_schema_listed():
+    """Every emitted *Metrics type is in ROLE_METRICS_SCHEMA, and the
+    schema has no stale entries for event types nothing emits (kept honest
+    both ways via the emitting call sites)."""
+    emitted = set()
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "foundationdb_tpu"
+    for path in pkg.rglob("*.py"):
+        src = path.read_text()
+        for node in ast.walk(ast.parse(src)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("spawn_role_metrics", "spawn_wire_metrics")
+            ):
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                            and arg.value.endswith("Metrics"):
+                        emitted.add(arg.value)
+                if node.func.id == "spawn_wire_metrics":
+                    emitted.add("WireMetrics")
+    assert emitted == set(ROLE_METRICS_SCHEMA), (
+        f"emitters {emitted} vs schema {set(ROLE_METRICS_SCHEMA)}"
+    )
+
+
+# -- trace_tool: the cross-process join --------------------------------------
+
+
+def test_trace_tool_joins_files_and_extracts_series(tmp_path):
+    """trace_tool reads rolled trace files from several 'processes', joins
+    one debug ID's timeline across them with role/host attribution, and
+    extracts a named metric time-series."""
+    from foundationdb_tpu.tools import trace_tool
+
+    # two "processes", each with its own rolling trace file + wall clock
+    a = TraceCollector(
+        clock=lambda: 1.0,
+        sink=TraceFileSink(str(tmp_path / "proc-a"), roll_size=300),
+        machine="host-a",
+    )
+    b = TraceCollector(
+        clock=lambda: 2.0,
+        sink=TraceFileSink(str(tmp_path / "proc-b"), roll_size=300),
+        machine="host-b",
+    )
+    a.trace("TransactionDebug", Location="NativeAPI.commit.Before", ID="t1")
+    time.sleep(0.01)
+    b.trace("TransactionDebug",
+            Location="CommitProxyServer.commitBatch.Before", ID="t1")
+    b.trace("TransactionDebug",
+            Location="Resolver.resolveBatch.After", ID="t1")
+    time.sleep(0.01)
+    a.trace("TransactionDebug", Location="NativeAPI.commit.After", ID="t1")
+    for i in range(6):
+        b.trace("ProxyMetrics", TxnsCommittedPerSec=float(i), Elapsed=0.5)
+
+    events = trace_tool.load_events([str(tmp_path)])
+    joined = trace_tool.join_timelines(events)
+    rep = trace_tool.report_from_stations("t1", joined["t1"])
+    assert rep["station_count"] == 4
+    assert rep["roles"] == ["client", "proxy", "resolver"]
+    # the join spanned BOTH processes' (rolled) files
+    assert {s.split(".")[0] for s in rep["sources"]} == {"proc-a", "proc-b"}
+    times = [s["time"] for s in rep["stations"]]
+    assert times == sorted(times)
+    # WallTime (not the per-process Time origins 1.0/2.0) ordered the join:
+    # the client's closing station sorts LAST despite its early clock
+    assert rep["stations"][0]["location"] == "NativeAPI.commit.Before"
+    assert rep["stations"][-1]["location"] == "NativeAPI.commit.After"
+    assert rep["stations"][0]["machine"] == "host-a"
+    assert rep["stations"][1]["machine"] == "host-b"
+
+    series = trace_tool.metric_series(events, "ProxyMetrics",
+                                      "TxnsCommittedPerSec")
+    assert [p["value"] for p in series] == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    hist = trace_tool.event_histogram(events)
+    assert hist["by_type"]["TransactionDebug"]["count"] == 4
+    assert hist["by_type"]["ProxyMetrics"]["count"] == 6
+
+    # the CLI surface renders the same join
+    out = trace_tool.run_report([str(tmp_path), "--id", "t1"])
+    assert "NativeAPI.commit.Before" in out and "proxy" in out
+
+    # slowest-transactions ranking includes t1
+    slow = trace_tool.top_slow(events, 3)
+    assert any(r["id"] == "t1" for r in slow)
+
+
+def test_timeline_is_a_thin_consumer_of_the_join():
+    """tools/timeline.py reports come from the same report builder as
+    trace_tool (role attribution present in the in-memory view too)."""
+    from foundationdb_tpu.tools.timeline import timeline_report
+
+    g_trace_batch.attach_clock(lambda: 5.0)
+    g_trace_batch.add("CommitProxyServer.commitBatch.Before", "x1")
+    g_trace_batch.add("TLog.tLogCommit.AfterTLogCommit", "x1")
+    rep = timeline_report("x1")
+    assert rep["station_count"] == 2
+    assert rep["roles"] == ["proxy", "tlog"]
+    assert rep["stations"][0]["role"] == "proxy"
+    g_trace_batch.attach_clock(lambda: 0.0)
+
+
+# -- guard: WARN+ event types unique and schema-listed -----------------------
+
+
+def _warn_trace_call_sites():
+    """Every `trace(...)` / `_trace_wire_error(...)` call site in the
+    package with a literal event-type name, flagged WARN+ when the call
+    names SEV_WARN/SEV_WARN_ALWAYS/SEV_ERROR (conditional severities count:
+    the event CAN warn) — _trace_wire_error hardwires SEV_WARN."""
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "foundationdb_tpu"
+    sites = []
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+            if name not in ("trace", "_trace_wire_error"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            warn = name == "_trace_wire_error"
+            for kw in node.keywords:
+                if kw.arg == "severity":
+                    warn = warn or bool({
+                        n.id for n in ast.walk(kw.value)
+                        if isinstance(n, ast.Name)
+                    } & {"SEV_WARN", "SEV_WARN_ALWAYS", "SEV_ERROR"})
+            sites.append((node.args[0].value, warn, f"{path.name}:{node.lineno}"))
+    return sites
+
+
+def test_warn_event_types_unique_and_schema_listed():
+    """The status-schema discipline for warning traces: every SEV_WARN+
+    event type is registered in WARN_EVENT_TYPES, each has exactly ONE
+    call site (no silent shadowing in track_latest / cluster.messages),
+    and the registry carries no stale names."""
+    warn_sites = [(n, at) for n, w, at in _warn_trace_call_sites() if w]
+    names = [n for n, _at in warn_sites]
+    dupes = {n for n in names if names.count(n) > 1}
+    assert not dupes, f"WARN+ event types with multiple call sites: {dupes}"
+    unregistered = set(names) - WARN_EVENT_TYPES
+    assert not unregistered, (
+        f"WARN+ trace events not in runtime/trace.py WARN_EVENT_TYPES: "
+        f"{[(n, at) for n, at in warn_sites if n in unregistered]}"
+    )
+    stale = WARN_EVENT_TYPES - set(names)
+    assert not stale, f"WARN_EVENT_TYPES entries with no call site: {stale}"
+
+
+# -- sampling-off overhead smoke ---------------------------------------------
+
+
+def _fixed_workload_wall(knobs: CoreKnobs) -> float:
+    """The fixed 600-commit sim workload (the PR-5 measurement shape):
+    returns host wall seconds."""
+    c = SimCluster(seed=17, n_resolvers=2, n_tlogs=2, knobs=knobs)
+    db = c.database()
+
+    async def drive():
+        for i in range(600):
+            tr = db.create_transaction()
+            tr.set(b"w%03d" % (i % 251), b"v")
+            await tr.commit()
+
+    t0 = time.perf_counter()
+    c.run_until(c.loop.spawn(drive()), 300.0)
+    wall = time.perf_counter() - t0
+    c.stop()
+    return wall
+
+
+def test_tracing_plane_overhead_sampling_off():
+    """With sampling OFF, the tracing plane (span plumbing + metrics
+    emitters + collector) must cost <2% wall on the fixed 600-commit sim
+    workload vs a maximally quiesced plane.  min-of-2 per config with up
+    to three measurement rounds (host-timing smoke de-flaking)."""
+    def quiesced() -> CoreKnobs:
+        k = CoreKnobs()
+        k.METRICS_INTERVAL = 1e9   # emitters never fire
+        k.TRACE_SEVERITY = 1 << 20  # collector drops everything
+        return k
+
+    _fixed_workload_wall(CoreKnobs())  # warmup (JIT/imports/allocator)
+    last = None
+    for _round in range(3):
+        base = min(_fixed_workload_wall(quiesced()) for _ in range(2))
+        plane = min(_fixed_workload_wall(CoreKnobs()) for _ in range(2))
+        last = (plane, base)
+        if plane <= base * 1.02:
+            return
+    plane, base = last
+    raise AssertionError(
+        f"tracing plane regressed the sampling-off workload "
+        f">2%: {plane:.3f}s vs {base:.3f}s"
+    )
