@@ -50,6 +50,10 @@ class SimCluster:
         from .runtime.trace import g_trace_batch, spawn_wire_metrics
 
         g_trace_batch.attach_clock(self.loop.now, self.trace)
+        # Net2 slow-task watch: a run-loop callback stalling past the knob
+        # (host wall) traces a SEV_WARN SlowTask into this collector
+        self.loop.slow_task_trace = self.trace
+        self.loop.slow_task_trace_threshold = self.knobs.SLOW_TASK_THRESHOLD
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         self._wire_metrics_task = spawn_wire_metrics(
             self.loop, self.trace, self.net.wire,
@@ -165,6 +169,7 @@ class SimCluster:
 
     def stop(self) -> None:
         self._wire_metrics_task.cancel()
+        self.loop.slow_task_trace = None
         self.proxy.stop()
         for r in self.resolvers:
             r.stop()

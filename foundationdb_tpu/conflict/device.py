@@ -1724,7 +1724,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
                 # kernel is pure, so the replay is exact
                 self.search_fallbacks += 1
                 self.stats.search_fallbacks += 1
-                testcov("kernel.search_fallback")
+                testcov("kernel.search_fallback.flat")
                 iters = _levels(self._cap) + 1
             new_count_i = int(new_count)
             if new_count_i <= self._cap:
@@ -1811,7 +1811,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
                 break
             self.search_fallbacks += 1
             self.stats.search_fallbacks += 1
-            testcov("kernel.search_fallback")
+            testcov("kernel.search_fallback.lsm")
             iters = _levels(self._cap) + 1
             rec_iters = _levels(self._rec_cap) + 1
         nrc_i = int(nrc)
@@ -1904,7 +1904,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
                     break
                 self.search_fallbacks += 1
                 self.stats.search_fallbacks += 1
-                testcov("kernel.search_fallback")
+                testcov("kernel.search_fallback.inc")
                 iters = _levels(self._cap) + 1
         self._runs_b, self._runs_e, self._runs_ver = nb, ne, nv
         self._n_runs += 1
@@ -1940,7 +1940,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
                 break
             self.search_fallbacks += 1
             self.stats.search_fallbacks += 1
-            testcov("kernel.search_fallback")
+            testcov("kernel.search_fallback.inc_timed")
             iters = _levels(self._cap) + 1
         t = time.perf_counter()
         verdict, w_ins = _inc_check_kernel(

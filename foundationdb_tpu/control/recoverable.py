@@ -63,6 +63,12 @@ class RecoverableCluster:
                                 # 0 = roles constructed directly
         trace_sink=None,        # file-like: trace events stream to it as
                                 # JSONL (the reference's rolling trace files)
+        debug_sample_rate: float = 0.0,  # fraction of every database()'s
+                                # transactions given a pipeline-timeline
+                                # debug ID (g_traceBatch sampling) — the
+                                # per-seed artifact hook soak campaigns
+                                # use so failing seeds carry joinable
+                                # transaction timelines in their traces
         remote_region: bool = False,  # a second region: a log router pulls
                                 # the full stream once and re-serves it to
                                 # remote read replicas of every shard
@@ -103,12 +109,17 @@ class RecoverableCluster:
             clock=self.loop.now, sink=trace_sink,
             min_severity=self.knobs.TRACE_SEVERITY,
         )
+        self.debug_sample_rate = debug_sample_rate
         from ..runtime.trace import g_trace_batch, spawn_wire_metrics
 
         # the collector bind mirrors every pipeline station into the trace
         # stream (and thus the trace FILES a production server rolls) as
         # TransactionDebug events — the cross-process join key surface
         g_trace_batch.attach_clock(self.loop.now, self.trace)
+        # Net2 slow-task watch: a run-loop callback stalling past the knob
+        # (host wall) traces a SEV_WARN SlowTask into this collector
+        self.loop.slow_task_trace = self.trace
+        self.loop.slow_task_trace_threshold = self.knobs.SLOW_TASK_THRESHOLD
         self.net = SimNetwork(self.loop, self.rng, self.trace)
         self._wire_metrics_task = spawn_wire_metrics(
             self.loop, self.trace, self.net.wire,
@@ -692,8 +703,10 @@ class RecoverableCluster:
             (b"\xff\xff/excluded/", _excluded_rows),
             (b"\xff\xff/server_list/", _serverlist_rows),
         ]
-        return Database(self.loop, view, self.rng,
-                        client_knobs=self.client_knobs)
+        db = Database(self.loop, view, self.rng,
+                      client_knobs=self.client_knobs)
+        db.debug_sample_rate = self.debug_sample_rate
+        return db
 
     def run_until(self, fut, deadline: float | None = None):
         return self.loop.run_until(fut, deadline)
@@ -708,6 +721,7 @@ class RecoverableCluster:
         """
         assert self.fs is not None, "power_off needs a durable cluster"
         self._wire_metrics_task.cancel()
+        self.loop.slow_task_trace = None
         if getattr(self, "_monitor_task", None) is not None:
             self._monitor_task.cancel()
         for w in self.workers:
@@ -729,6 +743,7 @@ class RecoverableCluster:
 
     def stop(self) -> None:
         self._wire_metrics_task.cancel()
+        self.loop.slow_task_trace = None
         if getattr(self, "_monitor_task", None) is not None:
             self._monitor_task.cancel()
         for w in self.workers:

@@ -524,6 +524,33 @@ for _spec in ROLE_METRICS_SCHEMA.values():
     _spec["Instance"] = str
 
 
+# -- coverage census events (runtime/coverage.py + runtime/buggify.py) -------
+#
+# One `CodeCoverage` event per testcov name / buggify site, emitted at sim
+# teardown (the reference's coveragetool rows, ridden over the trace plane
+# so the soak driver can scrape census data out of per-seed trace files
+# instead of a side channel).  Kind says which namespace the Name lives
+# in; Armed distinguishes a buggify site that enabled this run from one
+# that only fired because a test force()d it.
+
+CODE_COVERAGE_SCHEMA: dict = {
+    "Name": str,
+    "Kind": str,   # "testcov" | "buggify"
+    "Hits": int,
+    "Armed": bool,
+}
+
+
+def validate_coverage_event(ev: dict) -> None:
+    """Raise ValueError where a `CodeCoverage` trace event violates its
+    schema (same field-spec machinery as the status document)."""
+    if ev.get("Type") != "CodeCoverage":
+        raise ValueError(f"not a CodeCoverage event: {ev.get('Type')!r}")
+    if ev.get("Kind") not in ("testcov", "buggify"):
+        raise ValueError(f"coverage.Kind: unknown kind {ev.get('Kind')!r}")
+    validate_status(ev, CODE_COVERAGE_SCHEMA, "coverage")
+
+
 def validate_metrics_event(ev: dict) -> None:
     """Raise ValueError where a `*Metrics` trace event violates its schema
     (unknown metrics event types also raise: a new role metric must be

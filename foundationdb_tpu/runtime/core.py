@@ -311,6 +311,14 @@ class EventLoop:
         self.slow_task_threshold = 0.05
         self.busy_s_by_priority: dict[int, float] = {}
         self.slow_tasks: list[tuple[float, int, float]] = []  # (t, pri, dur)
+        # Net2 slow-task watch (Net2.actor.cpp checkForSlowTask): when a
+        # TraceCollector is bound here, any single callback whose host wall
+        # time exceeds slow_task_trace_threshold traces a SEV_WARN SlowTask
+        # event — a run-loop stall is invisible to virtual time, so only
+        # the wall clock can see it.  Observability only: the measurement
+        # never feeds back into scheduling, so determinism holds.
+        self.slow_task_trace = None
+        self.slow_task_trace_threshold = 0.5
 
     # -- time --------------------------------------------------------------
     def now(self) -> float:
@@ -354,16 +362,25 @@ class EventLoop:
         if when > self._now:
             self._now = when
         self.tasks_run += 1
-        if not self.profile:
+        watch = self.slow_task_trace
+        if not self.profile and watch is None:
             fn()
             return True
         t0 = _time.perf_counter()
         fn()
         dur = _time.perf_counter() - t0
         pri = -negpri
-        self.busy_s_by_priority[pri] = self.busy_s_by_priority.get(pri, 0.0) + dur
-        if dur >= self.slow_task_threshold and len(self.slow_tasks) < 10_000:
-            self.slow_tasks.append((self._now, pri, dur))
+        if self.profile:
+            self.busy_s_by_priority[pri] = self.busy_s_by_priority.get(pri, 0.0) + dur
+            if dur >= self.slow_task_threshold and len(self.slow_tasks) < 10_000:
+                self.slow_tasks.append((self._now, pri, dur))
+        if watch is not None and dur >= self.slow_task_trace_threshold:
+            from .trace import SEV_WARN
+
+            watch.trace(
+                "SlowTask", severity=SEV_WARN,
+                Priority=pri, DurationS=dur,
+            )
         return True
 
     def run_until(self, fut: Future, deadline: float | None = None) -> Any:

@@ -5,7 +5,14 @@ sites and simulation asserts they were all hit across a test campaign).
 Code marks a rare-but-important path with `testcov("name")`.  Counters are
 process-global and cheap (a dict increment); seed-sweep tests assert that
 the paths a campaign is supposed to exercise actually fired — the defense
-against fault-injection code that silently stops injecting."""
+against fault-injection code that silently stops injecting.
+
+For campaigns that span OS processes (tools/soak.py), the census leaves
+the process through the trace plane: `emit_coverage(trace)` lands one
+`CodeCoverage` event per hit name (schema'd in control/status.py
+CODE_COVERAGE_SCHEMA) in the run's trace files at sim teardown, and the
+soak driver scrapes those — coverage rides the same rolling-JSONL plane
+as every other observability signal instead of a side channel."""
 
 from __future__ import annotations
 
@@ -27,3 +34,40 @@ def all_hits() -> dict[str, int]:
 
 def reset() -> None:
     _hits.clear()
+
+
+def snapshot() -> dict[str, int]:
+    """The current counters, for save/restore around a test (the pytest
+    conftest isolates every test's census with this pair)."""
+    return dict(_hits)
+
+
+def restore(snap: dict[str, int]) -> None:
+    _hits.clear()
+    _hits.update(snap)
+
+
+def census(baseline: dict[str, int] | None = None) -> dict[str, int]:
+    """Hit counts, optionally as the DELTA over a `snapshot()` baseline —
+    how one spec run / one campaign seed reports only its own hits when
+    the process-global counters carry earlier runs' too."""
+    if not baseline:
+        return dict(_hits)
+    out: dict[str, int] = {}
+    for name, n in _hits.items():
+        d = n - baseline.get(name, 0)
+        if d > 0:
+            out[name] = d
+    return out
+
+
+def emit_coverage(trace, baseline: dict[str, int] | None = None) -> None:
+    """One `CodeCoverage` trace event per hit name (delta over `baseline`
+    when given) — the sim-teardown emission the soak driver's census is
+    built from.  A testcov site is 'armed' by definition: it has no
+    per-run enable draw, so Armed is always True here (contrast
+    buggify.emit_coverage, where armed-but-never-fired is the interesting
+    row)."""
+    for name, n in sorted(census(baseline).items()):
+        trace.trace("CodeCoverage", Name=name, Kind="testcov",
+                    Hits=n, Armed=True)

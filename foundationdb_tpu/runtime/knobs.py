@@ -127,6 +127,12 @@ class CoreKnobs(Knobs):
         self.init("TRACE_ROLL_SIZE", 10 << 20)
         self.init("TRACE_MAX_LOGS", 10)
         self.init("METRICS_INTERVAL", 5.0)
+        # Net2 slow-task analog: one run-loop callback exceeding this many
+        # HOST WALL seconds traces a SEV_WARN SlowTask event (the stall a
+        # virtual clock cannot see — a long jit compile, a blocking
+        # syscall).  Soak triage (tools/soak.py) surfaces the per-seed
+        # SlowTask count.
+        self.init("SLOW_TASK_THRESHOLD", 0.5)
 
         # commit-plane wire (docs/WIRE.md): transport write coalescing.
         # Queued frames flush once per reactor tick, or immediately once a

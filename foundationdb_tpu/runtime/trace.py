@@ -35,6 +35,10 @@ WARN_EVENT_TYPES = frozenset({
     "TransportDecodeFailed",     # rpc/transport.py: undecodable frame body
     "TransportProtocolMismatch", # rpc/transport.py: mixed-version peer
     "RkUpdate",                  # control/ratekeeper.py: limiting reason
+    "SlowTask",                  # runtime/core.py: run-loop callback over
+                                 # SLOW_TASK_THRESHOLD host wall seconds
+    "SoakSeedFailed",            # tools/soak.py: a campaign seed's verdict
+                                 # with the failure, for triage scrapes
 })
 
 

@@ -5,6 +5,10 @@ same role code production would run) and exposes the operational verbs:
 reads/writes, range scans, status, and chaos (kill a pipeline process to
 watch recovery).  Scriptable: `echo "set k v; get k" | python -m
 foundationdb_tpu.tools.cli`.
+
+Batch subcommand: `cli soak SPEC [--seeds N ...]` runs a multi-seed soak
+campaign (tools/soak.py; runbook in docs/OPERATIONS.md) and exits with
+the campaign verdict instead of opening the REPL.
 """
 
 from __future__ import annotations
@@ -337,6 +341,12 @@ class Cli:
 
 
 def main() -> None:
+    # batch subcommands ride the same entry point as the REPL (fdbcli's
+    # --exec flavor): `cli soak SPEC ...` runs a soak campaign and exits
+    if len(sys.argv) > 1 and sys.argv[1] == "soak":
+        from .soak import main as soak_main
+
+        sys.exit(soak_main(sys.argv[2:]))
     Cli().repl()
 
 

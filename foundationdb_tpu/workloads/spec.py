@@ -25,8 +25,14 @@ Format (one file = one simulation):
 
 `testName` opens a workload stanza; parameters until the next `testName`
 are constructor kwargs (camelCase -> snake_case).  Everything before the
-first `testName` configures the cluster.  `run_spec` builds the cluster,
-composes the workloads, runs them, and returns the metrics dict."""
+first `testName` configures the cluster — including `backend=supervised`
+(the DeviceSupervisor-wrapped TPU/XLA conflict backend) and
+`sampleRate=R` (transaction-timeline sampling into the trace files).
+`run_spec` builds the cluster, composes the workloads, runs them, and
+returns the metrics dict; its seed/trace_sink/sample_rate keywords are
+the per-seed artifact hooks the soak harness (tools/soak.py) drives, and
+teardown emits the run's buggify/testcov census as `CodeCoverage` trace
+events."""
 
 from __future__ import annotations
 
@@ -39,6 +45,7 @@ from .configure_db import ConfigureDatabaseWorkload
 from .conflict_range import ConflictRangeWorkload
 from .consistency import ConsistencyCheckWorkload
 from .cycle import CycleWorkload
+from .device_fault import DeviceFaultWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
 from .readwrite import ReadWriteWorkload
@@ -60,6 +67,7 @@ WORKLOAD_FACTORY = {
     "ReadWrite": ReadWriteWorkload,
     "Swizzle": SwizzleWorkload,
     "WriteDuringRead": WriteDuringReadWorkload,
+    "DeviceFault": DeviceFaultWorkload,
 }
 
 # spec key -> RecoverableCluster kwarg
@@ -76,7 +84,32 @@ _CLUSTER_KEYS = {
     "engine": ("storage_engine", str),
     "redundancy": ("redundancy", str),
     "chaos": ("chaos", "bool"),
+    # fraction of transactions given a pipeline-timeline debug ID — the
+    # per-seed trace-artifact hook (soak campaigns override per run)
+    "sampleRate": ("debug_sample_rate", float),
+    # conflict backend by name: "oracle" (default) or "supervised" (the
+    # DeviceSupervisor-wrapped TPU/XLA kernel — required for device.*
+    # buggify sites to mean anything); resolved in run_spec
+    "backend": ("backend", str),
 }
+
+# spec `backend=` values -> conflict-backend factories
+_BACKENDS = {
+    "oracle": None,
+}
+
+
+def _supervised_backend(oldest: int = 0):
+    from ..conflict.device import DeviceConflictSet
+    from ..conflict.supervisor import DeviceSupervisor
+
+    return DeviceSupervisor(
+        lambda o=0: DeviceConflictSet(o, capacity=1 << 10),
+        oldest_version=oldest,
+    )
+
+
+_BACKENDS["supervised"] = _supervised_backend
 
 
 def _parse_bool(v: str) -> bool:
@@ -144,23 +177,55 @@ def parse_spec(text: str) -> tuple[str, dict, list[tuple[str, dict]]]:
     return title, cluster_kwargs, stanzas
 
 
-def run_spec(text: str, deadline: float = 900.0) -> dict:
-    """Parse, build the cluster, compose the workloads, run, check."""
+def run_spec(text: str, deadline: float = 900.0, *, seed: int | None = None,
+             trace_sink=None, sample_rate: float | None = None) -> dict:
+    """Parse, build the cluster, compose the workloads, run, check.
+
+    The keyword hooks are the per-seed artifact surface soak campaigns
+    drive (tools/soak.py): `seed` overrides the spec's cluster seed (the
+    campaign's seed matrix beats the file's fixed value), `trace_sink`
+    streams the run's trace events into rolling files, and `sample_rate`
+    overrides the spec's `sampleRate` so every seed carries joinable
+    transaction timelines.  At teardown — pass OR fail — the run's
+    buggify/testcov census is emitted into the trace stream as
+    `CodeCoverage` events (runtime/{buggify,coverage}.py), which is how
+    coverage crosses the process boundary to the campaign driver."""
     from ..control.recoverable import RecoverableCluster
-    from ..runtime import buggify
+    from ..runtime import buggify, coverage
 
     title, cluster_kwargs, stanzas = parse_spec(text)
-    c = RecoverableCluster(**cluster_kwargs)
+    backend = cluster_kwargs.pop("backend", "oracle")
+    if backend not in _BACKENDS:
+        raise ValueError(
+            f"unknown backend {backend!r} (known: {sorted(_BACKENDS)})"
+        )
+    if _BACKENDS[backend] is not None:
+        cluster_kwargs["conflict_backend"] = _BACKENDS[backend]
+    if seed is not None:
+        cluster_kwargs["seed"] = seed
+    if sample_rate is not None:
+        cluster_kwargs["debug_sample_rate"] = sample_rate
+    cov_base = coverage.snapshot()
+    c = RecoverableCluster(trace_sink=trace_sink, **cluster_kwargs)
     try:
         workloads = [WORKLOAD_FACTORY[name](**kw) for name, kw in stanzas]
         metrics = run_workloads(c, workloads, deadline=deadline)
         metrics["testTitle"] = title
+        metrics["seed"] = cluster_kwargs.get("seed", 0)
         return metrics
     finally:
+        # census emission must precede stop()/disable(): disabling clears
+        # the buggify census, and the collector's sink is what carries
+        # coverage to a cross-process campaign driver
+        buggify.emit_coverage(c.trace)
+        coverage.emit_coverage(c.trace, baseline=cov_base)
         c.stop()
         buggify.disable()
 
 
-def run_spec_file(path: str, deadline: float = 900.0) -> dict:
+def run_spec_file(path: str, deadline: float = 900.0, *,
+                  seed: int | None = None, trace_sink=None,
+                  sample_rate: float | None = None) -> dict:
     with open(path) as f:
-        return run_spec(f.read(), deadline=deadline)
+        return run_spec(f.read(), deadline=deadline, seed=seed,
+                        trace_sink=trace_sink, sample_rate=sample_rate)

@@ -36,9 +36,32 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 _xb._backend_factories.pop("axon", None)
 
 
+import pytest  # noqa: E402
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: device-dependent or long-running; excluded from tier-1 "
         "(-m 'not slow')",
     )
+
+
+@pytest.fixture(autouse=True)
+def _census_isolation():
+    """Coverage counters (runtime/coverage.py) and buggify arming state
+    (runtime/buggify.py) are process-global; without isolation they bleed
+    between tests and census numbers depend on which tests ran before.
+    Every test starts with an empty census and a disabled buggify, and
+    whatever it armed/hit is rolled back afterwards — even when the test
+    body raises mid-run.  (tests/test_soak.py pins this with a
+    regression pair.)"""
+    from foundationdb_tpu.runtime import buggify, coverage
+
+    cov_snap = coverage.snapshot()
+    bug_snap = buggify.snapshot()
+    coverage.reset()
+    buggify.disable()
+    yield
+    coverage.restore(cov_snap)
+    buggify.restore(bug_snap)

@@ -193,16 +193,21 @@ def test_chaos_device_faults_mid_pipeline(monkeypatch):
           or explicitly degraded — never wedged,
       (c) cluster_status reports the device health roll-up,
 
-    and each site is *required* to have fired (runtime/coverage.py
-    discipline: fault injection that silently stops injecting fails here)."""
+    and each site is *required* to have fired — asserted through the soak
+    driver's merged coverage census (tools/soak.py), the same API a
+    cross-process campaign uses: fault injection that silently stops
+    injecting fails here."""
     from foundationdb_tpu.conflict.device import DeviceConflictSet
     from foundationdb_tpu.conflict.oracle import OracleConflictSet
     from foundationdb_tpu.conflict.supervisor import DeviceSupervisor
     from foundationdb_tpu.control.status import cluster_status, validate_status
     from foundationdb_tpu.runtime import coverage
+    from foundationdb_tpu.tools import soak
 
     monkeypatch.setenv("FDBTPU_PIPELINE", "1")
+    per_seed: dict = {}
     for i, site in enumerate(DEVICE_SITES):
+        cov_base = coverage.snapshot()
         mismatches: list = []
 
         def make_cs(oldest=0, _m=mismatches):
@@ -252,14 +257,27 @@ def test_chaos_device_faults_mid_pipeline(monkeypatch):
         doc = cluster_status(c)
         validate_status(doc)
         assert "device" in doc["kernel"], site
+        # per-seed census, captured BEFORE disable() clears the buggify
+        # half (the same order tools/soak.py's teardown emission uses)
+        per_seed[site] = soak.seed_census(cov_base)
         c.stop()
         buggify.disable()
-    # the campaign-level coverage contract: every device fault class was
-    # exercised AND at least one full breaker trip actually happened
-    for site in DEVICE_SITES:
-        assert coverage.hits(f"buggify.{site}") >= 1, site
-    assert coverage.hits("device.cpu_rebuild") >= 1
-    assert coverage.hits("device.degraded") >= 1, "no breaker trip all campaign"
+    # the campaign-level coverage contract through the merged census: every
+    # device fault class fired in some seed AND at least one full breaker
+    # trip actually happened (soak.check_required is the same check a
+    # required-coverage manifest drives in a cross-process campaign)
+    merged = soak.merge_census(per_seed)
+    missing = soak.check_required(
+        merged,
+        [f"buggify.{s}" for s in DEVICE_SITES]
+        + ["device.cpu_rebuild", "device.degraded"],
+    )
+    assert missing == [], f"campaign census missing required sites: {missing}"
+    # and the armed-vs-hit gap is empty for the fault classes under test:
+    # every ARMED device.* buggify site was HIT across the sweep
+    for site, row in merged["buggify"].items():
+        if site.startswith("device.") and row["armed_seeds"]:
+            assert row["hit_seeds"] >= 1, f"{site} armed but never fired"
 
 
 def test_sweep_covers_rare_paths():

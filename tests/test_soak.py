@@ -1,0 +1,313 @@
+"""Soak-campaign harness (tools/soak.py + cli soak): per-seed subprocess
+runs with trace-file artifacts, verdict classification, the merged
+buggify/testcov coverage census against a required-coverage manifest,
+automatic failure triage (first errors, slowest sampled transaction,
+SlowTask counts, repro command), the SlowTask reactor event, the spec
+per-seed hooks, and the conftest census-isolation fixture."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+from foundationdb_tpu.control.status import validate_coverage_event
+from foundationdb_tpu.runtime import buggify, coverage
+from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+from foundationdb_tpu.runtime.trace import (
+    SEV_ERROR,
+    SEV_WARN,
+    TraceCollector,
+    TraceFileSink,
+)
+from foundationdb_tpu.tools import soak, trace_tool
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+MINI_SPEC = """\
+testTitle=MiniSoak
+seed=7
+chaos=true
+
+testName=Cycle
+nodes=6
+clients=2
+txnsPerClient=4
+
+testName=Attrition
+kills=1
+interval=2.0
+startDelay=0.8
+"""
+
+
+def _write_spec(tmp_path, coverage_lines="recovery.triggered\n"):
+    spec = tmp_path / "Mini.txt"
+    spec.write_text(MINI_SPEC)
+    (tmp_path / "Mini.coverage").write_text(coverage_lines)
+    return spec
+
+
+# -- census primitives -------------------------------------------------------
+
+
+def test_census_merge_and_required_check():
+    per_seed = {
+        1: {"buggify": {"a": {"armed": True, "fires": 2},
+                        "b": {"armed": True, "fires": 0}},
+            "testcov": {"x": 3, "buggify.a": 2}},
+        2: {"buggify": {"a": {"armed": False, "fires": 0},
+                        "b": {"armed": True, "fires": 0}},
+            "testcov": {"y": 1}},
+    }
+    m = soak.merge_census(per_seed)
+    assert m["buggify"]["a"] == {"armed_seeds": 1, "hit_seeds": 1, "fires": 2}
+    # the silently-stopped-injecting shape: armed in both seeds, never hit
+    assert m["buggify"]["b"] == {"armed_seeds": 2, "hit_seeds": 0, "fires": 0}
+    assert m["testcov"]["x"] == {"hit_seeds": 1, "hits": 3}
+    assert soak.check_required(m, ["x", "y", "buggify.a"]) == []
+    assert soak.check_required(m, ["buggify.b", "z", "x"]) == ["buggify.b", "z"]
+
+
+def test_census_round_trips_through_trace_plane(tmp_path):
+    """The cross-process path: buggify/coverage emit CodeCoverage events
+    into a trace file; census_from_events rebuilds the same census."""
+    rng = DeterministicRandom(5)
+    buggify.enable(rng)
+    buggify.force("soaktest.site", 2)
+    assert buggify.buggify("soaktest.site")
+    assert buggify.buggify("soaktest.site")
+    # forced but never reaching its guard: must still census as ARMED with
+    # zero fires — the silently-stopped-injecting row, not a missing row
+    buggify.force("soaktest.unreached", 3)
+    coverage.testcov("soaktest.path")
+    sink = TraceFileSink(str(tmp_path / "t"))
+    tc = TraceCollector(sink=sink)
+    buggify.emit_coverage(tc)
+    coverage.emit_coverage(tc)
+    sink.close()
+    events = trace_tool.load_events([str(tmp_path)])
+    for ev in events:
+        validate_coverage_event(ev)
+    census = soak.census_from_events(events)
+    assert census["buggify"]["soaktest.site"] == {"armed": True, "fires": 2}
+    assert census["buggify"]["soaktest.unreached"] == {
+        "armed": True, "fires": 0,
+    }
+    assert census["testcov"]["soaktest.path"] == 1
+    assert census["testcov"]["buggify.soaktest.site"] == 2
+    # in-process flavor agrees
+    direct = soak.seed_census()
+    assert direct["buggify"]["soaktest.site"]["fires"] == 2
+
+
+def test_coverage_census_baseline_delta():
+    coverage.testcov("soaktest.before")
+    base = coverage.snapshot()
+    coverage.testcov("soaktest.after")
+    coverage.testcov("soaktest.before")
+    c = coverage.census(base)
+    assert c == {"soaktest.after": 1, "soaktest.before": 1}
+
+
+# -- the SlowTask reactor event ----------------------------------------------
+
+
+def test_slow_task_traced_at_sev_warn():
+    """A run-loop callback stalling past the threshold (host wall) traces
+    SlowTask at SEV_WARN with its priority and duration; fast callbacks
+    stay silent."""
+    loop = EventLoop()
+    tc = TraceCollector()
+    loop.slow_task_trace = tc
+    loop.slow_task_trace_threshold = 0.01
+
+    async def slow():
+        time.sleep(0.02)
+
+    async def fast():
+        pass
+
+    loop.run_until(loop.spawn(slow()))
+    evs = tc.find("SlowTask")
+    assert evs, "stalled callback traced no SlowTask"
+    assert evs[0]["Severity"] == SEV_WARN
+    assert evs[0]["DurationS"] >= 0.01
+    assert "Priority" in evs[0]
+    n = len(evs)
+    loop.run_until(loop.spawn(fast()))
+    assert len(tc.find("SlowTask")) == n  # fast path added nothing
+
+
+def test_slow_task_watch_off_by_default():
+    loop = EventLoop()
+    assert loop.slow_task_trace is None  # bare loops pay no timing
+
+
+# -- spec per-seed artifact hooks --------------------------------------------
+
+
+def test_run_spec_seed_sink_and_sampling_hooks(tmp_path):
+    """run_spec's soak hooks: seed override beats the file's, trace events
+    stream into the sink, the teardown census rides the trace plane as
+    schema-valid CodeCoverage events, and sample_rate lands joinable
+    TransactionDebug stations in the files."""
+    from foundationdb_tpu.workloads.spec import run_spec
+
+    sink = TraceFileSink(str(tmp_path / "trace"))
+    m = run_spec(MINI_SPEC, deadline=600.0, seed=4242, trace_sink=sink,
+                 sample_rate=1.0)
+    sink.close()
+    assert m["seed"] == 4242
+    assert m["Cycle"]["committed"] == 8
+    events = trace_tool.load_events([str(tmp_path)])
+    cov = [e for e in events if e["Type"] == "CodeCoverage"]
+    assert cov, "teardown emitted no CodeCoverage events"
+    for ev in cov:
+        validate_coverage_event(ev)
+    census = soak.census_from_events(events)
+    assert census["buggify"], "chaos run queried no buggify sites"
+    assert census["testcov"].get("recovery.triggered", 0) >= 1
+    assert any(e["Type"] == "TransactionDebug" for e in events)
+
+
+def test_run_spec_rejects_unknown_backend():
+    import pytest
+
+    from foundationdb_tpu.workloads.spec import run_spec
+
+    with pytest.raises(ValueError, match="unknown backend"):
+        run_spec("backend=bogus\ntestName=Cycle\n")
+
+
+# -- the campaign driver -----------------------------------------------------
+
+
+def test_soak_campaign_verdicts_census_and_triage(tmp_path, monkeypatch):
+    """Acceptance: a campaign writes JSON+markdown reports with per-seed
+    verdicts, a merged census with zero missing required sites, and — one
+    seed forced to fail — a triage block carrying the first-error events,
+    the slowest sampled transaction, and the repro command."""
+    spec = _write_spec(tmp_path)
+    monkeypatch.setenv("FDBTPU_SOAK_FORCE_FAIL", "3001")
+    report = soak.run_campaign(
+        str(spec), [3000, 3001, 3002], str(tmp_path / "out"),
+        jobs=3, seed_deadline=240.0,
+    )
+    assert report["verdicts"] == {"pass": 2, "fail": 1,
+                                  "timeout": 0, "crash": 0}
+    assert not report["ok"]
+    # the manifest (recovery.triggered: every seed's attrition kill) is
+    # fully covered even though one seed failed
+    assert report["coverage"]["missing_required"] == []
+    assert report["coverage"]["merged"]["testcov"][
+        "recovery.triggered"]["hit_seeds"] == 3
+    assert set(report["coverage"]["per_seed"]) == {"3000", "3001", "3002"}
+
+    failing = [r for r in report["per_seed"] if r["verdict"] == "fail"]
+    assert [r["seed"] for r in failing] == [3001]
+    t = failing[0]["triage"]
+    assert any(
+        ev["Type"] == "SoakSeedFailed" and ev["Severity"] >= SEV_ERROR
+        for ev in t["first_events"]
+    ), t["first_events"]
+    assert t["error_count"] >= 1
+    assert "slow_task_count" in t
+    st = t["slowest_transaction"]
+    assert st is not None and st["station_count"] >= 3, (
+        "triage carried no joined transaction timeline"
+    )
+    assert "--first-seed 3001" in t["repro"]
+    assert str(spec) in t["repro"]
+
+    # artifacts: reports on disk, failing seed keeps its traces for the
+    # repro loop, passing seeds are scraped-and-pruned
+    out = tmp_path / "out"
+    assert json.loads((out / "campaign.json").read_text())["ok"] is False
+    md = (out / "campaign.md").read_text()
+    assert "seed 3001 — fail" in md and "repro" in md
+    assert "buggify site" in md and "testcov name" in md
+    assert (out / "seed-3001").is_dir()
+    assert not (out / "seed-3000").exists()
+
+
+def test_soak_repro_command_reruns_the_failing_seed(tmp_path):
+    """The triage 'unseed' is a working command line: running it (through
+    the cli soak subcommand, which is what it names) reruns exactly that
+    seed and reproduces the failure."""
+    import subprocess
+
+    spec = _write_spec(tmp_path)
+    cmd = soak.repro_command(str(spec), 3001).split()
+    assert cmd[0] == "python"
+    cmd[0] = sys.executable
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", FDBTPU_SOAK_FORCE_FAIL="3001",
+        PYTHONPATH=str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    p = subprocess.run(cmd, cwd=str(tmp_path), env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 1, p.stdout + p.stderr  # the failure reproduced
+    rep = json.loads((tmp_path / "repro-3001" / "campaign.json").read_text())
+    assert rep["per_seed"][0]["seed"] == 3001
+    assert rep["per_seed"][0]["verdict"] == "fail"
+    assert rep["per_seed"][0]["triage"]["first_events"]
+
+
+def test_soak_timeout_verdict_and_triage(tmp_path):
+    """A seed overrunning its wall deadline is killed and recorded as
+    timeout — with a triage block built from whatever its line-buffered
+    trace files captured before the kill."""
+    spec = tmp_path / "Long.txt"
+    spec.write_text(
+        "testTitle=LongRun\n\n"
+        "testName=Cycle\nnodes=8\nclients=2\ntxnsPerClient=100000\n"
+    )
+    report = soak.run_campaign(
+        str(spec), [3000], str(tmp_path / "out"), jobs=1, seed_deadline=3.0,
+    )
+    assert report["verdicts"]["timeout"] == 1
+    r = report["per_seed"][0]
+    assert r["verdict"] == "timeout"
+    assert "deadline" in r["error"]
+    assert "repro" in r["triage"]
+
+
+# -- conftest census isolation (satellite regression pair) -------------------
+# Part 1 deliberately pollutes the process-global census; part 2 (running
+# after it — tier-1 disables random ordering) must see none of it.  This
+# is the cross-test-leak regression the autouse fixture exists to pin.
+
+
+def test_census_isolation_part1_pollutes():
+    coverage.testcov("soaktest.isolation_probe")
+    buggify.enable(DeterministicRandom(1))
+    buggify.force("soaktest.isolation_site")
+    assert buggify.buggify("soaktest.isolation_site")
+    assert coverage.hits("soaktest.isolation_probe") == 1
+    assert buggify.is_enabled()
+    assert buggify.census()["soaktest.isolation_site"]["fires"] == 1
+
+
+def test_census_isolation_part2_sees_clean_state():
+    assert coverage.hits("soaktest.isolation_probe") == 0
+    assert coverage.all_hits() == {}
+    assert not buggify.is_enabled()
+    assert buggify.census() == {}
+
+
+def test_census_snapshot_restore_round_trip():
+    coverage.testcov("soaktest.snap")
+    cov = coverage.snapshot()
+    bug = buggify.snapshot()
+    buggify.enable(DeterministicRandom(2))
+    buggify.force("soaktest.snap_site")
+    buggify.buggify("soaktest.snap_site")
+    coverage.testcov("soaktest.snap")
+    coverage.restore(cov)
+    buggify.restore(bug)
+    assert coverage.hits("soaktest.snap") == 1
+    assert not buggify.is_enabled()
+    assert buggify.census() == {}
