@@ -7,8 +7,8 @@ the resulting stacks and observations diffed byte-for-byte).
 keyspace; `StackMachine(driver).run(ops)` interprets it against any object
 implementing the driver surface:
 
-    new_txn() -> txn;  txn.set/get/clear_range/get_range/atomic_add/
-    commit/reset
+    new_txn() -> txn;  txn.set/get/clear_range/get_range/get_key/
+    get_range_selector/atomic_add/commit/reset
 
 and returns a DIGEST — the observation log plus the final stack.  Two
 bindings conform iff their digests for the same seed are equal.  Commit
@@ -46,7 +46,7 @@ def gen_ops(seed: int, n: int = 120) -> list[tuple]:
 
     ops: list[tuple] = []
     for _ in range(n):
-        k = rng.randrange(12)
+        k = rng.randrange(14)
         if k < 2:
             ops.append(("PUSH", key()))
         elif k == 2:
@@ -74,8 +74,20 @@ def gen_ops(seed: int, n: int = 120) -> list[tuple]:
                 ops.append(("GET_STACK_TOP",))
         elif k == 10:
             ops.append(("COMMIT",))
-        else:
+        elif k == 11:
             ops.append(("RESET",))
+        elif k == 12:
+            # selector resolution: (key, or_equal, offset) spanning the
+            # whole first_greater_or_equal family, with offsets that step
+            # off either end of the keyspace (clamped to "" / "\xff" —
+            # every binding must agree byte-for-byte)
+            ops.append(("GET_KEY", key(), rng.randrange(2) == 1,
+                        rng.randrange(-4, 5)))
+        else:
+            ops.append(("GET_RANGE_SELECTOR", *sorted((key(), key())),
+                        rng.randrange(2) == 1, rng.randrange(-2, 3),
+                        rng.randrange(2) == 1, rng.randrange(-2, 3),
+                        rng.randrange(1, 20)))
     ops.append(("COMMIT",))
     ops.append(("GET_RANGE", b"bt/", b"bt0", 1000))  # final full scan
     return ops
@@ -111,6 +123,17 @@ class StackMachine:
                 packed = b";".join(k + b"=" + v for k, v in rows)
                 self.stack.append(packed)
                 self.log.append(("range", op[1], op[2], op[3], packed))
+            elif kind == "GET_KEY":
+                resolved = tr.get_key(op[1], op[2], op[3])
+                self.stack.append(resolved)
+                self.log.append(("getkey", resolved))
+            elif kind == "GET_RANGE_SELECTOR":
+                rows = tr.get_range_selector(
+                    op[1], op[3], op[4], op[2], op[5], op[6], op[7]
+                )
+                packed = b";".join(k + b"=" + v for k, v in rows)
+                self.stack.append(packed)
+                self.log.append(("rangesel", packed))
             elif kind == "ATOMIC_ADD":
                 tr.atomic_add(op[1], op[2])
             elif kind == "SET_OPTION":

@@ -96,6 +96,42 @@ int main(int argc, char **argv) {
     CHECK(n_rows == 2, "range row count"); /* c/ctr, c/one */
     free(blob);
 
+    /* key selectors resolve server-side: first_greater_or_equal("c/")
+     * lands on c/ctr, first_greater_than("c/ctr") on c/one, and walking
+     * past the last key clamps to the keyspace boundary "\xff" */
+    {
+      uint8_t *resolved;
+      uint32_t rlen;
+      CHECK(fdbtpu_txn_get_key(db, txn, (const uint8_t *)"c/", 2,
+                               /*or_equal=*/0, /*offset=*/1, &resolved,
+                               &rlen) == 0,
+            "get_key fge");
+      CHECK(rlen == 5 && memcmp(resolved, "c/ctr", 5) == 0, "fge resolves");
+      free(resolved);
+      CHECK(fdbtpu_txn_get_key(db, txn, (const uint8_t *)"c/ctr", 5,
+                               /*or_equal=*/1, /*offset=*/1, &resolved,
+                               &rlen) == 0,
+            "get_key fgt");
+      CHECK(rlen == 5 && memcmp(resolved, "c/one", 5) == 0, "fgt resolves");
+      free(resolved);
+      CHECK(fdbtpu_txn_get_key(db, txn, (const uint8_t *)"c/one", 5,
+                               /*or_equal=*/1, /*offset=*/100, &resolved,
+                               &rlen) == 0,
+            "get_key overflow");
+      CHECK(rlen == 1 && resolved[0] == 0xff, "overflow clamps to \\xff");
+      free(resolved);
+
+      uint32_t n_rows, blob_len;
+      uint8_t *blob;
+      CHECK(fdbtpu_txn_get_range_selector(
+                db, txn, (const uint8_t *)"c/", 2, 0, 1,
+                (const uint8_t *)"c/one", 5, 1, 1, 100, &n_rows, &blob,
+                &blob_len) == 0,
+            "get_range_selector");
+      CHECK(n_rows == 2, "selector range rows"); /* c/ctr, c/one */
+      free(blob);
+    }
+
     /* transaction options route end to end (lock_aware on an unlocked
      * database is a no-op, an unknown option is refused) */
     CHECK(fdbtpu_txn_set_option(db, txn, (const uint8_t *)"lock_aware", 10) == 0,

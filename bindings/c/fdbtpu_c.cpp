@@ -267,6 +267,72 @@ int fdbtpu_txn_get_range(FDBTPU_Database *db, uint64_t txn,
   return st;
 }
 
+/* wire KeySelector: u32 klen, key, u8 or_equal, i32 offset (5 fixed bytes) */
+static uint32_t put_sel(uint8_t *p, const uint8_t *key, uint32_t key_len,
+                        int or_equal, int32_t offset) {
+  put_u32(p, key_len);
+  memcpy(p + 4, key, key_len);
+  p[4 + key_len] = or_equal ? 1 : 0;
+  memcpy(p + 5 + key_len, &offset, 4);
+  return 4 + key_len + 5;
+}
+
+int fdbtpu_txn_get_key(FDBTPU_Database *db, uint64_t txn, const uint8_t *key,
+                       uint32_t key_len, int or_equal, int32_t offset,
+                       uint8_t **resolved, uint32_t *resolved_len) {
+  uint32_t blen = 8 + 4 + key_len + 5;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  put_sel(b + 8, key, key_len, or_equal, offset);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 15, b, blen, &out, &out_len);
+  free(b);
+  *resolved = NULL;
+  *resolved_len = 0;
+  if (st == 0 && out_len >= 4) {
+    uint32_t rlen = get_u32(out);
+    if (rlen <= out_len - 4) {
+      *resolved = (uint8_t *)malloc(rlen ? rlen : 1);
+      memcpy(*resolved, out + 4, rlen);
+      *resolved_len = rlen;
+    }
+  }
+  free(out);
+  return st;
+}
+
+int fdbtpu_txn_get_range_selector(
+    FDBTPU_Database *db, uint64_t txn,
+    const uint8_t *bkey, uint32_t bkey_len, int b_or_equal, int32_t b_offset,
+    const uint8_t *ekey, uint32_t ekey_len, int e_or_equal, int32_t e_offset,
+    uint32_t limit, uint32_t *n_rows, uint8_t **blob, uint32_t *blob_len) {
+  uint32_t blen = 8 + (4 + bkey_len + 5) + (4 + ekey_len + 5) + 4;
+  uint8_t *b = (uint8_t *)malloc(blen);
+  put_u64(b, txn);
+  uint32_t off = 8;
+  off += put_sel(b + off, bkey, bkey_len, b_or_equal, b_offset);
+  off += put_sel(b + off, ekey, ekey_len, e_or_equal, e_offset);
+  put_u32(b + off, limit);
+  uint8_t *out = NULL;
+  uint32_t out_len = 0;
+  int st = rpc(db, 16, b, blen, &out, &out_len);
+  free(b);
+  *n_rows = 0;
+  *blob = NULL;
+  *blob_len = 0;
+  if (st == 0 && out_len >= 4) {
+    *n_rows = get_u32(out);
+    *blob_len = out_len - 4;
+    if (*blob_len) {
+      *blob = (uint8_t *)malloc(*blob_len);
+      memcpy(*blob, out + 4, *blob_len);
+    }
+  }
+  free(out);
+  return st;
+}
+
 int fdbtpu_txn_commit(FDBTPU_Database *db, uint64_t txn, int64_t *version) {
   uint8_t body[8];
   put_u64(body, txn);

@@ -62,6 +62,22 @@ int fdbtpu_txn_get_range(FDBTPU_Database *db, uint64_t txn,
                          uint32_t limit, uint32_t *n_rows,
                          uint8_t **blob, uint32_t *blob_len);
 
+/* Resolve a KeySelector (fdb_transaction_get_key): (key, or_equal, offset)
+ * in the first_greater_or_equal family; offset overflow clamps to the
+ * keyspace boundary ("" / "\xff") instead of erroring.  *resolved is
+ * malloc'd (caller frees; may be zero-length). */
+int fdbtpu_txn_get_key(FDBTPU_Database *db, uint64_t txn,
+                       const uint8_t *key, uint32_t key_len,
+                       int or_equal, int32_t offset,
+                       uint8_t **resolved, uint32_t *resolved_len);
+
+/* Range read with KeySelector endpoints; blob layout as get_range. */
+int fdbtpu_txn_get_range_selector(
+    FDBTPU_Database *db, uint64_t txn,
+    const uint8_t *bkey, uint32_t bkey_len, int b_or_equal, int32_t b_offset,
+    const uint8_t *ekey, uint32_t ekey_len, int e_or_equal, int32_t e_offset,
+    uint32_t limit, uint32_t *n_rows, uint8_t **blob, uint32_t *blob_len);
+
 int fdbtpu_txn_commit(FDBTPU_Database *db, uint64_t txn, int64_t *version);
 int fdbtpu_txn_get_read_version(FDBTPU_Database *db, uint64_t txn,
                                 int64_t *version);

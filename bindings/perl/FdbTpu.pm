@@ -102,6 +102,49 @@ sub get_range {
     return \@rows;
 }
 
+# wire KeySelector: length-prefixed key, u8 or_equal, i32 offset
+sub _wsel {
+    my ($k, $or_equal, $offset) = @_;
+    return _wstr($k) . pack('C l<', $or_equal ? 1 : 0, $offset);
+}
+
+# Resolve a KeySelector server-side (GET_KEY, op 15); args (key, or_equal,
+# offset) default to first_greater_or_equal(key).  Offset overflow clamps
+# to the keyspace boundary ("" / "\xff") — docs/API.md.
+sub get_key {
+    my ($self, $t, $k, $or_equal, $offset) = @_;
+    $or_equal //= 0;
+    $offset   //= 1;
+    my $out = $self->_call(15, pack('Q<', $t) . _wsel($k, $or_equal, $offset));
+    my ($len) = unpack('V', $out);
+    return substr($out, 4, $len);
+}
+
+sub _parse_rows {
+    my ($out) = @_;
+    my ($n) = unpack('V', $out);
+    my $off = 4;
+    my @rows;
+    for (1 .. $n) {
+        my ($kl) = unpack('V', substr($out, $off, 4)); $off += 4;
+        my $k = substr($out, $off, $kl); $off += $kl;
+        my ($vl) = unpack('V', substr($out, $off, 4)); $off += 4;
+        my $v = substr($out, $off, $vl); $off += $vl;
+        push @rows, [$k, $v];
+    }
+    return \@rows;
+}
+
+# Range read with KeySelector endpoints (GET_RANGE_SELECTOR, op 16).
+sub get_range_selector {
+    my ($self, $t, $bk, $boe, $boff, $ek, $eoe, $eoff, $limit) = @_;
+    $limit //= 10000;
+    my $out = $self->_call(
+        16, pack('Q<', $t) . _wsel($bk, $boe, $boff) . _wsel($ek, $eoe, $eoff)
+            . pack('V', $limit));
+    return _parse_rows($out);
+}
+
 sub atomic_add {
     my ($self, $t, $k, $delta) = @_;
     $self->_call(10, pack('Q<', $t) . _wstr($k) . pack('q<', $delta));

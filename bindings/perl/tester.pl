@@ -44,6 +44,18 @@ for my $op (@{ $spec->{ops} }) {
         my $packed = join(';', map { $_->[0] . '=' . $_->[1] } @$rows);
         push @stack, $packed;
         push @log, ['range', $args[0], $args[1], $args[2], b64($packed)];
+    } elsif ($kind eq 'GET_KEY') {
+        my $resolved = $db->get_key(
+            $t, decode_base64($args[0]), $args[1], $args[2]);
+        push @stack, $resolved;
+        push @log, ['getkey', b64($resolved)];
+    } elsif ($kind eq 'GET_RANGE_SELECTOR') {
+        my $rows = $db->get_range_selector(
+            $t, decode_base64($args[0]), $args[1], $args[2],
+            decode_base64($args[3]), $args[4], $args[5], $args[6]);
+        my $packed = join(';', map { $_->[0] . '=' . $_->[1] } @$rows);
+        push @stack, $packed;
+        push @log, ['rangesel', b64($packed)];
     } elsif ($kind eq 'ATOMIC_ADD') {
         $db->atomic_add($t, decode_base64($args[0]), $args[1]);
     } elsif ($kind eq 'SET_OPTION') {

@@ -67,16 +67,9 @@ class _Txn:
             )
         )
 
-    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
-        n = ctypes.c_uint32()
-        blob = ctypes.POINTER(ctypes.c_uint8)()
-        blob_len = ctypes.c_uint32()
-        self._db._check(
-            self._db._lib.fdbtpu_txn_get_range(
-                self._db._h, self._tid, begin, len(begin), end, len(end),
-                limit, ctypes.byref(n), ctypes.byref(blob), ctypes.byref(blob_len),
-            )
-        )
+    def _take_rows(self, n, blob, blob_len):
+        """Copy out + free a malloc'd row blob (u32 klen, key, u32 vlen,
+        value — the layout every range-shaped C call replies with)."""
         raw = bytes(bytearray(blob[i] for i in range(blob_len.value)))
         if blob_len.value:
             self._db._libc.free(blob)
@@ -88,10 +81,61 @@ class _Txn:
             off += klen
             vlen = int.from_bytes(raw[off : off + 4], "little")
             off += 4
-            v = raw[off : off + vlen]
+            rows.append((k, raw[off : off + vlen]))
             off += vlen
-            rows.append((k, v))
         return rows
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
+        n = ctypes.c_uint32()
+        blob = ctypes.POINTER(ctypes.c_uint8)()
+        blob_len = ctypes.c_uint32()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_get_range(
+                self._db._h, self._tid, begin, len(begin), end, len(end),
+                limit, ctypes.byref(n), ctypes.byref(blob), ctypes.byref(blob_len),
+            )
+        )
+        return self._take_rows(n, blob, blob_len)
+
+    def get_key(self, key: bytes, or_equal: bool = False,
+                offset: int = 1) -> bytes:
+        """Resolve a KeySelector (fdb_transaction_get_key); defaults are
+        first_greater_or_equal(key).  Offset overflow clamps to the
+        keyspace boundary (b"" / b"\\xff") — docs/API.md."""
+        resolved = ctypes.POINTER(ctypes.c_uint8)()
+        rlen = ctypes.c_uint32()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_get_key(
+                self._db._h, self._tid, key, len(key),
+                1 if or_equal else 0, offset,
+                ctypes.byref(resolved), ctypes.byref(rlen),
+            )
+        )
+        out = bytes(bytearray(resolved[i] for i in range(rlen.value)))
+        if resolved:
+            self._db._libc.free(resolved)
+        return out
+
+    def get_range_selector(self, begin_key: bytes, begin_or_equal: bool,
+                           begin_offset: int, end_key: bytes,
+                           end_or_equal: bool, end_offset: int,
+                           limit: int = 10000):
+        """Range read with KeySelector endpoints (blob layout shared with
+        get_range)."""
+        n = ctypes.c_uint32()
+        blob = ctypes.POINTER(ctypes.c_uint8)()
+        blob_len = ctypes.c_uint32()
+        self._db._check(
+            self._db._lib.fdbtpu_txn_get_range_selector(
+                self._db._h, self._tid,
+                begin_key, len(begin_key), 1 if begin_or_equal else 0,
+                begin_offset,
+                end_key, len(end_key), 1 if end_or_equal else 0, end_offset,
+                limit, ctypes.byref(n), ctypes.byref(blob),
+                ctypes.byref(blob_len),
+            )
+        )
+        return self._take_rows(n, blob, blob_len)
 
     def commit(self) -> int:
         version = ctypes.c_int64()
@@ -160,6 +204,15 @@ class FdbTpu:
                                        C.POINTER(u32)]
         lib.fdbtpu_txn_get_range.argtypes = [
             C.c_void_p, u64, C.c_char_p, u32, C.c_char_p, u32, u32,
+            C.POINTER(u32), C.POINTER(u8p), C.POINTER(u32),
+        ]
+        lib.fdbtpu_txn_get_key.argtypes = [
+            C.c_void_p, u64, C.c_char_p, u32, C.c_int, C.c_int32,
+            C.POINTER(u8p), C.POINTER(u32),
+        ]
+        lib.fdbtpu_txn_get_range_selector.argtypes = [
+            C.c_void_p, u64, C.c_char_p, u32, C.c_int, C.c_int32,
+            C.c_char_p, u32, C.c_int, C.c_int32, u32,
             C.POINTER(u32), C.POINTER(u8p), C.POINTER(u32),
         ]
         lib.fdbtpu_txn_commit.argtypes = [C.c_void_p, u64, C.POINTER(i64)]

@@ -70,10 +70,8 @@ class Transaction:
     def clear_range(self, begin: bytes, end: bytes) -> None:
         self._db._call(5, self._body(begin, end))
 
-    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
-        body = self._db._call(
-            7, self._body(begin, end, bytearray(struct.pack("<I", limit)))
-        )
+    @staticmethod
+    def _parse_rows(body: bytes):
         (n,) = struct.unpack_from("<I", body, 0)
         off = 4
         rows = []
@@ -87,6 +85,38 @@ class Transaction:
             rows.append((k, bytes(body[off : off + vl])))
             off += vl
         return rows
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 10000):
+        body = self._db._call(
+            7, self._body(begin, end, bytearray(struct.pack("<I", limit)))
+        )
+        return self._parse_rows(body)
+
+    @staticmethod
+    def _sel(key: bytes, or_equal: bool, offset: int) -> list:
+        """Wire form of one KeySelector: key, u8 or_equal, i32 offset."""
+        return [key, bytearray(struct.pack("<Bi", 1 if or_equal else 0, offset))]
+
+    def get_key(self, key: bytes, or_equal: bool = False, offset: int = 1) -> bytes:
+        """Resolve a KeySelector server-side (GET_KEY, op 15).  Defaults are
+        first_greater_or_equal(key); selector semantics — offset stepping,
+        boundary clamps — in docs/API.md."""
+        body = self._db._call(15, self._body(*self._sel(key, or_equal, offset)))
+        (n,) = struct.unpack_from("<I", body, 0)
+        return bytes(body[4 : 4 + n])
+
+    def get_range_selector(self, begin_key: bytes, begin_or_equal: bool,
+                           begin_offset: int, end_key: bytes,
+                           end_or_equal: bool, end_offset: int,
+                           limit: int = 10000):
+        """Range read with KeySelector endpoints (GET_RANGE_SELECTOR, op 16):
+        both endpoints resolve server-side, then the window is read."""
+        body = self._db._call(16, self._body(
+            *self._sel(begin_key, begin_or_equal, begin_offset),
+            *self._sel(end_key, end_or_equal, end_offset),
+            bytearray(struct.pack("<I", limit)),
+        ))
+        return self._parse_rows(body)
 
     def atomic_add(self, key: bytes, delta: int) -> None:
         self._db._call(

@@ -16,14 +16,17 @@ from __future__ import annotations
 
 from ..roles.proxy import KeyPartitionMap
 from ..roles.types import (
+    CLIENT_KEYSPACE_END,
     CommitReply,
     CommitResult,
     CommitTransactionRequest,
     CommitUnknownResult,
     FutureVersion,
+    GetKeyRequest,
     GetKeyValuesRequest,
     GetReadVersionRequest,
     GetValueRequest,
+    KeySelector,
     Mutation,
     MutationType,
     NotCommitted,
@@ -54,6 +57,22 @@ RETRYABLE_ERRORS = (
     TimedOut,
     BrokenPromise,
 )
+
+
+def selector_conflict_range(
+    sel: KeySelector, resolved: bytes
+) -> tuple[bytes, bytes] | None:
+    """The read-conflict range a getKey adds (NativeAPI.actor.cpp
+    getKeyAndConflictRange): the span whose contents DETERMINED the
+    resolution — any write inside it could move the resolved position.
+    Backward selectors depend on [resolved, anchor), forward ones on
+    (anchor, resolved]; or_equal widens the anchor side to include the
+    anchor key itself.  None when the span is empty."""
+    if sel.offset <= 0:
+        b, e = resolved, (key_after(sel.key) if sel.or_equal else sel.key)
+    else:
+        b, e = (key_after(sel.key) if sel.or_equal else sel.key), key_after(resolved)
+    return (b, e) if b < e else None
 
 
 def _intersect_ranges(
@@ -198,6 +217,40 @@ class Database:
         # (g_traceBatch; the reference samples via CLIENT_KNOBS->
         # *_DEBUG_TRANSACTION_RATE)
         self.debug_sample_rate = 0.0
+        # RYW SnapshotCache counters, aggregated across every transaction
+        # this handle creates (client/snapshot_cache.py); surfaced in
+        # cluster_status and the periodic ClientMetrics trace event
+        from .snapshot_cache import CacheStats
+
+        self.cache_stats = CacheStats()
+        self._metrics_emitter = None
+
+    def start_metrics(self, trace, interval: float, process=None):
+        """Periodic ClientMetrics emission — the client-side slice of the
+        `*Metrics` plane (the reference's TransactionMetrics): RYW cache
+        hit/miss/insert/eviction rates plus the live cache-byte gauge."""
+        from ..runtime.trace import spawn_role_metrics
+
+        if self._metrics_emitter is not None:
+            self._metrics_emitter.cancel()
+
+        def fields() -> dict:
+            r = self.cache_stats.counters.rates(self.loop.now())
+            snap = self.cache_stats.snapshot()
+            return {
+                "CacheHitsPerSec": r.get("cache_hits", 0.0),
+                "CacheMissesPerSec": r.get("cache_misses", 0.0),
+                "CacheInsertsPerSec": r.get("cache_inserts", 0.0),
+                "CacheEvictionsPerSec": r.get("cache_evictions", 0.0),
+                "SelectorReadsPerSec": r.get("selector_reads", 0.0),
+                "CacheBytes": snap["bytes"],
+                "CachedTransactions": snap["transactions"],
+            }
+
+        self._metrics_emitter = spawn_role_metrics(
+            self.loop, process, trace, "ClientMetrics", fields, interval,
+        )
+        return self._metrics_emitter
 
     @property
     def _grv(self) -> RequestStreamRef:
@@ -462,9 +515,83 @@ class Transaction:
             self._read_ranges.append((key, key_after(key)))
         return reply.value
 
+    # -- key selectors (NativeAPI.actor.cpp getKey) --------------------------
+    def _selector_route(self, sel: KeySelector) -> tuple[int, bytes, bytes]:
+        """(member index, shard begin, shard end) for one resolution step.
+        A backward selector anchored EXACTLY on a shard boundary routes to
+        the shard on the LEFT (the reference's Reverse getKeyLocation):
+        every key it can resolve to lives there."""
+        smap = self.db._smap
+        idx = smap.position_for_key(sel.key)
+        if sel.is_backward and idx > 0 and sel.key == smap.splits[idx - 1]:
+            idx -= 1
+        mb = smap.splits[idx - 1] if idx > 0 else b""
+        me = smap.splits[idx] if idx < len(smap.splits) else CLIENT_KEYSPACE_END
+        return idx, mb, min(me, CLIENT_KEYSPACE_END)
+
+    async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
+        """Resolve a KeySelector to an actual key (fdb_transaction_get_key).
+        Resolution happens SERVER-side: each step asks the shard the
+        selector currently points into; an offset stepping past the shard
+        boundary comes back as an updated selector for the next shard.  A
+        position before the first key clamps to b""; past the last user
+        key clamps to CLIENT_KEYSPACE_END (b"\\xff") — offset overflow
+        yields the boundary, never an error (docs/API.md)."""
+        if not isinstance(selector, KeySelector):
+            raise TypeError("get_key takes a KeySelector")
+        if selector.key.startswith(b"\xff\xff"):
+            raise ValueError("key selectors are not supported under \\xff\\xff")
+        v = await self.get_read_version()
+        sel = selector
+        g_trace_batch.add("NativeAPI.getKey.Before", self.debug_id)
+        while True:
+            # boundary clamps FIRST (the reference's allKeys.begin/end checks)
+            if sel.key >= CLIENT_KEYSPACE_END:
+                if sel.offset > 0:
+                    rep = CLIENT_KEYSPACE_END
+                    break
+                sel = KeySelector(CLIENT_KEYSPACE_END, False, sel.offset)
+            if sel.key == b"" and sel.offset <= 0:
+                rep = b""
+                break
+            idx, mb, me = self._selector_route(sel)
+            reply = await self._reply_rerouted(
+                lambda idx=idx: self.db._qm.pick(
+                    self.db._rng, self.db._smap.members[idx], "getkey"
+                ),
+                GetKeyRequest(sel, v, mb, me, debug_id=self.debug_id),
+            )
+            sel = reply.sel
+            if sel.is_resolved:
+                rep = sel.key
+                break
+        g_trace_batch.add("NativeAPI.getKey.After", self.debug_id)
+        if not snapshot:
+            cr = selector_conflict_range(selector, rep)
+            if cr is not None:
+                self._read_ranges.append(cr)
+        return rep
+
     async def get_range(
-        self, begin: bytes, end: bytes, limit: int = 10000, snapshot: bool = False
+        self,
+        begin: bytes | KeySelector,
+        end: bytes | KeySelector,
+        limit: int = 10000,
+        snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
+        if isinstance(begin, KeySelector) or isinstance(end, KeySelector):
+            # selector endpoints resolve server-side first (each adds its
+            # own narrow resolution conflict range); the data read then
+            # proceeds over the resolved window
+            b = begin if isinstance(begin, bytes) else await self.get_key(
+                begin, snapshot=snapshot
+            )
+            e = end if isinstance(end, bytes) else await self.get_key(
+                end, snapshot=snapshot
+            )
+            if b >= e:
+                return []
+            return await self.get_range(b, e, limit=limit, snapshot=snapshot)
         if begin.startswith(b"\xff\xff"):
             # special-key-space MODULE range read (SpecialKeySpace.actor.cpp:
             # `\xff\xff/<module>/...` ranges answered by handlers, not

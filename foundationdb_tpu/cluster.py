@@ -124,6 +124,8 @@ class SimCluster:
         )
 
         self.client_proc = self.net.create_process("client")
+        self.client_dbs: list[Database] = []
+        self._client_metric_tasks: list = []
 
         # the periodic *Metrics plane (runtime/trace.py spawn_role_metrics):
         # the statically-wired cluster starts every role's emitter itself —
@@ -152,6 +154,7 @@ class SimCluster:
                 {
                     "getvalue": self._ref(proc, ss.getvalue_stream.endpoint),
                     "getkeyvalues": self._ref(proc, ss.getkv_stream.endpoint),
+                    "getkey": self._ref(proc, ss.getkey_stream.endpoint),
                     "watch": self._ref(proc, ss.watch_stream.endpoint),
                 }
             ]
@@ -162,13 +165,21 @@ class SimCluster:
             commit_refs=[self._ref(proc, self.proxy.commit_stream.endpoint)],
             storage_map=KeyPartitionMap(self.storage_splits, storage_members),
         )
-        return Database(self.loop, view, self.rng)
+        db = Database(self.loop, view, self.rng)
+        # status + the periodic ClientMetrics plane see every handle
+        self.client_dbs.append(db)
+        self._client_metric_tasks.append(
+            db.start_metrics(self.trace, self.knobs.METRICS_INTERVAL, proc)
+        )
+        return db
 
     def run_until(self, fut, deadline: float | None = None):
         return self.loop.run_until(fut, deadline)
 
     def stop(self) -> None:
         self._wire_metrics_task.cancel()
+        for t in self._client_metric_tasks:
+            t.cancel()
         self.loop.slow_task_trace = None
         self.proxy.stop()
         for r in self.resolvers:

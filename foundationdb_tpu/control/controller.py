@@ -1156,6 +1156,7 @@ class ClusterController:
                         {
                             "getvalue": RequestStreamRef(self.net, client_proc, ss.getvalue_stream.endpoint),
                             "getkeyvalues": RequestStreamRef(self.net, client_proc, ss.getkv_stream.endpoint),
+                            "getkey": RequestStreamRef(self.net, client_proc, ss.getkey_stream.endpoint),
                             "watch": RequestStreamRef(self.net, client_proc, ss.watch_stream.endpoint),
                         }
                         for ss in team

@@ -110,6 +110,8 @@ class RecoverableCluster:
             min_severity=self.knobs.TRACE_SEVERITY,
         )
         self.debug_sample_rate = debug_sample_rate
+        self.client_dbs: list = []
+        self._client_metric_tasks: list = []
         from ..runtime.trace import g_trace_batch, spawn_wire_metrics
 
         # the collector bind mirrors every pipeline station into the trace
@@ -121,10 +123,6 @@ class RecoverableCluster:
         self.loop.slow_task_trace = self.trace
         self.loop.slow_task_trace_threshold = self.knobs.SLOW_TASK_THRESHOLD
         self.net = SimNetwork(self.loop, self.rng, self.trace)
-        self._wire_metrics_task = spawn_wire_metrics(
-            self.loop, self.trace, self.net.wire,
-            self.knobs.METRICS_INTERVAL, "sim",
-        )
         make_cs = conflict_backend or (lambda oldest=0: OracleConflictSet(oldest))
         self.fs = None
         if durable or fs is not None or restart:
@@ -405,6 +403,13 @@ class RecoverableCluster:
         self.controller.on_redundancy_change = self.dd.converge_redundancy
         if remote_region:
             self._make_remote_storage(n_storage_shards, make_store)
+        # spawned LAST: an __init__ that raises above (team policy refusals,
+        # bad config) must not leak a never-started emitter task — nothing
+        # would ever cancel it
+        self._wire_metrics_task = spawn_wire_metrics(
+            self.loop, self.trace, self.net.wire,
+            self.knobs.METRICS_INTERVAL, "sim",
+        )
 
     async def _change_coordinators(self, n: int) -> bool:
         """Coordinator-set change (ManagementAPI changeQuorum via
@@ -626,14 +631,20 @@ class RecoverableCluster:
                 [{
                     "getvalue": _Ref(self.net, proc, ss.getvalue_stream.endpoint),
                     "getkeyvalues": _Ref(self.net, proc, ss.getkv_stream.endpoint),
+                    "getkey": _Ref(self.net, proc, ss.getkey_stream.endpoint),
                     "watch": _Ref(self.net, proc, ss.watch_stream.endpoint),
                 }]
                 for ss in self.remote_storage
             ],
         )
         view.smap = view.pinned_smap
-        return Database(self.loop, view, self.rng,
-                        client_knobs=self.client_knobs)
+        db = Database(self.loop, view, self.rng,
+                      client_knobs=self.client_knobs)
+        self.client_dbs.append(db)
+        self._client_metric_tasks.append(
+            db.start_metrics(self.trace, self.knobs.METRICS_INTERVAL, proc)
+        )
+        return db
 
     @property
     def storage_splits(self) -> list[bytes]:
@@ -706,6 +717,11 @@ class RecoverableCluster:
         db = Database(self.loop, view, self.rng,
                       client_knobs=self.client_knobs)
         db.debug_sample_rate = self.debug_sample_rate
+        # status + the periodic ClientMetrics plane see every handle
+        self.client_dbs.append(db)
+        self._client_metric_tasks.append(
+            db.start_metrics(self.trace, self.knobs.METRICS_INTERVAL, proc)
+        )
         return db
 
     def run_until(self, fut, deadline: float | None = None):
@@ -743,6 +759,8 @@ class RecoverableCluster:
 
     def stop(self) -> None:
         self._wire_metrics_task.cancel()
+        for t in self._client_metric_tasks:
+            t.cancel()
         self.loop.slow_task_trace = None
         if getattr(self, "_monitor_task", None) is not None:
             self._monitor_task.cancel()

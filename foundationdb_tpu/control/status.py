@@ -249,6 +249,22 @@ def cluster_status(cluster) -> dict[str, Any]:
         if rwire is not None:
             snap["transport"] = rwire.snapshot()
 
+    # -- client-side RYW SnapshotCache counters -----------------------------
+    # aggregated across every Database handle the cluster handed out
+    # (client/snapshot_cache.py): hit/miss/insert/eviction totals, the live
+    # byte gauge, and selector resolutions through the merged view
+    dbs = getattr(cluster, "client_dbs", None)
+    if dbs is not None:
+        agg: dict[str, int] = {
+            "cache_hits": 0, "cache_misses": 0, "cache_inserts": 0,
+            "cache_evictions": 0, "selector_reads": 0, "bytes": 0,
+            "transactions": 0,
+        }
+        for db in dbs:
+            for k, v in db.cache_stats.snapshot().items():
+                agg[k] = agg.get(k, 0) + v
+        doc["clients"] = {"databases": len(dbs), "ryw_cache": agg}
+
     rk = getattr(cluster, "ratekeeper", None)
     doc["cluster"]["messages"] = _messages(trace, rk) + _device_messages(resolvers)
 
@@ -418,6 +434,20 @@ STATUS_SCHEMA: dict = {
         # its flushes/frames_per_flush are where coalescing actually shows
         "transport?": dict,
     },
+    # client-side RYW SnapshotCache roll-up (client/snapshot_cache.py):
+    # aggregated over every Database handle the cluster handed out
+    "clients?": {
+        "databases": int,
+        "ryw_cache": {
+            "cache_hits": int,
+            "cache_misses": int,
+            "cache_inserts": int,
+            "cache_evictions": int,
+            "selector_reads": int,
+            "bytes": int,
+            "transactions": int,
+        },
+    },
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
     "ratekeeper?": {
         "tps_budget": (int, float),
@@ -502,6 +532,16 @@ ROLE_METRICS_SCHEMA: dict = {
         "KnownCommitted": int,
         "EntriesPerSec": _NUM,
         "QueueDepth": int,
+    },
+    "ClientMetrics": {
+        "Elapsed": _NUM,
+        "CacheHitsPerSec": _NUM,
+        "CacheMissesPerSec": _NUM,
+        "CacheInsertsPerSec": _NUM,
+        "CacheEvictionsPerSec": _NUM,
+        "SelectorReadsPerSec": _NUM,
+        "CacheBytes": int,
+        "CachedTransactions": int,
     },
     "WireMetrics": {
         "Elapsed": _NUM,

@@ -21,10 +21,13 @@ from typing import Iterable
 from .sequencer import NotifiedVersion
 from .types import (
     FutureVersion,
+    GetKeyReply,
+    GetKeyRequest,
     GetKeyValuesReply,
     GetKeyValuesRequest,
     GetValueReply,
     GetValueRequest,
+    KeySelector,
     Mutation,
     MutationType,
     TLogPeekRequest,
@@ -280,6 +283,7 @@ class _FetchState:
 class StorageServer:
     WLT_GETVALUE = "wlt:ss_getvalue"
     WLT_GETKEYVALUES = "wlt:ss_getkeyvalues"
+    WLT_GETKEY = "wlt:ss_getkey"
     WLT_WATCH = "wlt:ss_watch"
 
     def __init__(
@@ -327,10 +331,12 @@ class StorageServer:
         self.read_latency = LatencyTracker()
         self.counters = CounterCollection("StorageServer")
         self.c_reads = self.counters.counter("reads")
+        self.c_selector_reads = self.counters.counter("selector_reads")
         self.c_mutations = self.counters.counter("mutations_applied")
         self._metrics_emitter = None
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
+        self.getkey_stream = RequestStream(process, self.WLT_GETKEY, unique=True)
         self.watch_stream = RequestStream(process, self.WLT_WATCH, unique=True)
         self._watches: dict[bytes, list] = {}  # key -> [(expected, req)]
         self._dur_task = loop.spawn(
@@ -340,6 +346,7 @@ class StorageServer:
             loop.spawn(self._pull(), TaskPriority.STORAGE_SERVER, f"ss-pull-{tag}"),
             loop.spawn(self._serve_getvalue(), TaskPriority.STORAGE_SERVER, f"ss-gv-{tag}"),
             loop.spawn(self._serve_getkv(), TaskPriority.STORAGE_SERVER, f"ss-gkv-{tag}"),
+            loop.spawn(self._serve_getkey(), TaskPriority.STORAGE_SERVER, f"ss-gk-{tag}"),
             loop.spawn(self._serve_watch(), TaskPriority.STORAGE_SERVER, f"ss-w-{tag}"),
             self._dur_task,
         ]
@@ -741,6 +748,125 @@ class StorageServer:
         self.c_reads.add(1)
         self.read_latency.observe(self.loop.now() - t0)
 
+    # -- key selectors (storageserver.actor.cpp findKey / getKeyQ) -----------
+    def _live_keys(self, version: Version, begin: bytes, end: bytes,
+                   limit: int, reverse: bool = False) -> list[bytes]:
+        """Up to `limit` keys LIVE at `version` in [begin, end), walking
+        forward (ascending) or backward (descending, for negative-offset
+        selectors).  Same base+overlay merge as _getkv_one.  The forward
+        walk scans base chunks and RE-FETCHES past a truncated chunk — a
+        window where more than a chunk's worth of base keys are dead at
+        this version (a large uncompacted clear) must not resolve against
+        a partial candidate set.  The backward walk materializes the
+        clip's candidate keys (no reverse cursor on the engines — the
+        clip is one shard, simulation-scale)."""
+        from ..keys import key_after
+
+        if begin >= end:
+            return []
+        if reverse:
+            base = self.store.range_read(begin, end, 1 << 30)
+            keys = set(k for k, _v in base)
+            keys.update(self.overlay.overlay_keys_in(begin, end))
+            out: list[bytes] = []
+            for k in sorted(keys, reverse=True):
+                if self.overlay.get(k, version, self.store.get) is not None:
+                    out.append(k)
+                    if len(out) >= limit:
+                        break
+            return out
+        out = []
+        cursor = begin
+        chunk = limit + 1000
+        while cursor < end and len(out) < limit:
+            base = self.store.range_read(cursor, end, chunk)
+            truncated = len(base) >= chunk
+            # knowledge is complete over [cursor, scan_end) only: overlay
+            # keys past a truncated base chunk wait for the next pass
+            scan_end = key_after(base[-1][0]) if truncated else end
+            keys = set(k for k, _v in base)
+            keys.update(self.overlay.overlay_keys_in(cursor, scan_end))
+            for k in sorted(keys):
+                if self.overlay.get(k, version, self.store.get) is not None:
+                    out.append(k)
+                    if len(out) >= limit:
+                        break
+            cursor = scan_end
+        return out
+
+    def find_key(self, sel: KeySelector, version: Version,
+                 range_begin: bytes, range_end: bytes) -> KeySelector:
+        """One shard's findKey step (storageserver.actor.cpp findKey): walk
+        `sel.offset` live keys from the anchor WITHIN [range_begin,
+        range_end).  Resolved result is (key, True, 0); a walk reaching the
+        shard edge returns a selector anchored at the boundary carrying the
+        REMAINING offset, which the client re-issues against the adjacent
+        shard — offsets step across shard boundaries without any server
+        knowing the whole keyspace."""
+        forward = sel.offset > 0
+        # a key EQUAL to the anchor is skipped when the anchor side already
+        # counted it: orEqual==forward (the reference's skipEqualKey)
+        skip_equal = sel.or_equal == forward
+        distance = sel.offset if forward else 1 - sel.offset
+        need = distance + (1 if skip_equal else 0)
+        if forward:
+            rows = self._live_keys(
+                version, max(sel.key, range_begin), range_end, need
+            )
+        else:
+            from ..keys import key_after
+
+            rows = self._live_keys(
+                version, range_begin, min(key_after(sel.key), range_end),
+                need, reverse=True,
+            )
+        index = distance - 1
+        if skip_equal and rows and rows[0] == sel.key:
+            index += 1
+        if index < len(rows):
+            return KeySelector(rows[index], True, 0)  # resolved
+        remaining = index - len(rows) + 1  # >= 1: keys still to step over
+        if forward:
+            # continue right: (range_end, False, remaining) — base position
+            # "last key < range_end" was the last key this shard counted
+            return KeySelector(range_end, False, remaining)
+        return KeySelector(range_begin, False, 1 - remaining)
+
+    async def _serve_getkey(self) -> None:
+        while True:
+            req = await self.getkey_stream.next()
+            self.loop.spawn(self._getkey_one(req), TaskPriority.STORAGE_SERVER)
+
+    async def _getkey_one(self, req) -> None:
+        r: GetKeyRequest = req.payload
+        t0 = self.loop.now()
+        g_trace_batch.add("StorageServer.getKey.Received", r.debug_id)
+        await maybe_delay(self.loop, "storage.delay_getkey")
+        # the walk may touch any key in the routed clip: guard the WHOLE
+        # clip against in-flight shard moves and moved-in floors, like a
+        # range read over it would be
+        try:
+            await self._wait_version(r.version)
+            if any(
+                fs.begin < r.range_end and r.range_begin < fs.end_key
+                for fs in self._fetching
+            ):
+                raise FutureVersion("range is still being fetched (shard move)")
+            if self._floor_violation(r.range_begin, r.range_end, r.version):
+                raise TransactionTooOld(
+                    f"version {r.version} below moved-shard floor"
+                )
+        except (TransactionTooOld, FutureVersion) as e:
+            req.reply_error(e)
+            return
+        req.reply(GetKeyReply(
+            self.find_key(r.sel, r.version, r.range_begin, r.range_end)
+        ))
+        self.c_reads.add(1)
+        self.c_selector_reads.add(1)
+        self.read_latency.observe(self.loop.now() - t0)
+        g_trace_batch.add("StorageServer.getKey.Replied", r.debug_id)
+
     def set_tlog_source(
         self,
         peek_ref: RequestStreamRef,
@@ -810,4 +936,5 @@ class StorageServer:
             self._metrics_emitter.cancel()
         self.getvalue_stream.close()
         self.getkv_stream.close()
+        self.getkey_stream.close()
         self.watch_stream.close()

@@ -43,6 +43,64 @@ class Mutation:
     value: bytes         # for CLEAR_RANGE: range end
 
 
+# end of the CLIENT-readable keyspace (fdbclient allKeys.end): selector
+# resolution clamps here, so a selector walking off either end of the user
+# data resolves to a boundary (b"" / CLIENT_KEYSPACE_END) instead of
+# leaking system (`\xff...`) keys or erroring
+CLIENT_KEYSPACE_END = b"\xff"
+
+
+@dataclasses.dataclass(frozen=True)
+class KeySelector:
+    """A key position relative to an anchor (fdbclient/FDBTypes.h
+    KeySelectorRef): resolve to the (offset)-th key after — or, for
+    offset <= 0, the (1-offset)-th key at/before — the anchor, where
+    or_equal says whether a key EQUAL to the anchor counts as "before".
+
+    The four reference constructors cover every position an application
+    layer names; arithmetic (`+ n`) shifts the offset, the reference's
+    `KeySelectorRef::operator+`.  The fully-RESOLVED form is
+    (key, or_equal=True, offset=0) — "the last key <= key" where `key` is
+    known to exist — which is also what a storage server replies once its
+    findKey walk lands (storageserver.actor.cpp getKeyQ)."""
+
+    key: bytes
+    or_equal: bool
+    offset: int
+
+    @classmethod
+    def last_less_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 0)
+
+    @classmethod
+    def first_greater_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 1)
+
+    @classmethod
+    def first_greater_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 1)
+
+    def __add__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset + n)
+
+    def __sub__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset - n)
+
+    @property
+    def is_backward(self) -> bool:
+        """True when resolution must look LEFT of the anchor first (the
+        reference's isBackward(): routes to the shard holding keys < key)."""
+        return not self.or_equal and self.offset <= 0
+
+    @property
+    def is_resolved(self) -> bool:
+        return self.or_equal and self.offset == 0
+
+
 VERSIONSTAMP_LEN = 10  # 8-byte big-endian version + 2-byte batch order
 
 
@@ -358,6 +416,32 @@ class GetKeyValuesRequest:
 class GetKeyValuesReply:
     data: list[tuple[bytes, bytes]]
     more: bool
+
+
+@dataclasses.dataclass
+class GetKeyRequest:
+    """Resolve a KeySelector server-side (StorageServerInterface.h
+    GetKeyRequest → storageserver.actor.cpp findKey).  [range_begin,
+    range_end) is the shard the CLIENT routed this to (its partition-map
+    view); the walk never counts keys outside it, so an offset stepping
+    past a shard boundary comes back as an UPDATED selector anchored at
+    the boundary for the client to continue on the adjacent shard —
+    shard-boundary-safe by construction."""
+
+    sel: KeySelector
+    version: Version
+    range_begin: bytes
+    range_end: bytes
+    debug_id: str | None = None
+
+
+@dataclasses.dataclass
+class GetKeyReply:
+    """Updated selector: resolved iff `sel.is_resolved` (then sel.key is
+    the answer); otherwise anchored at the queried shard's boundary with
+    the offset REMAINING (getKeyQ's updated-selector contract)."""
+
+    sel: KeySelector
 
 
 @dataclasses.dataclass
@@ -900,6 +984,60 @@ def _register_all() -> None:
         return WatchValueRequest(key, value, ver)
 
     reg(52, WatchValueRequest, _enc_watch_req, _dec_watch_req)
+
+    # selector resolution (getKey): `i32 offset + u8 or_equal + u32 klen +
+    # key` is THE selector framing, shared by request and reply so the two
+    # layouts can never drift
+    def _enc_sel(parts: list, s: KeySelector) -> None:
+        parts.append(_struct.pack("<iB", s.offset, 1 if s.or_equal else 0))
+        parts.append(_ST_I.pack(len(s.key)))
+        parts.append(s.key)
+
+    def _dec_sel(b: bytes, pos: int) -> tuple[KeySelector, int]:
+        off, oe = _struct.unpack_from("<iB", b, pos)
+        (nk,) = _ST_I.unpack_from(b, pos + 5)
+        key = b[pos + 9 : pos + 9 + nk]
+        if len(key) != nk:
+            raise CodecError("truncated selector key")
+        return KeySelector(key, oe == 1, off), pos + 9 + nk
+
+    def _enc_get_key_req(o, st, x):
+        parts = [_ST_q.pack(o.version)]
+        _enc_sel(parts, o.sel)
+        parts.append(_ST_I.pack(len(o.range_begin)))
+        parts.append(o.range_begin)
+        parts.append(_ST_I.pack(len(o.range_end)))
+        parts.append(o.range_end)
+        _opt_str(parts, o.debug_id)
+        return b"".join(parts)
+
+    def _dec_get_key_req(b, st):
+        (ver,) = _ST_q.unpack_from(b, 0)
+        sel, pos = _dec_sel(b, 8)
+        (nb,) = _ST_I.unpack_from(b, pos)
+        rb = b[pos + 4 : pos + 4 + nb]
+        if len(rb) != nb:
+            raise CodecError("truncated range begin")
+        pos += 4 + nb
+        (ne,) = _ST_I.unpack_from(b, pos)
+        re_ = b[pos + 4 : pos + 4 + ne]
+        if len(re_) != ne:
+            raise CodecError("truncated range end")
+        dbg, _pos = _read_opt_str(b, pos + 4 + ne)
+        return GetKeyRequest(sel, ver, rb, re_, debug_id=dbg)
+
+    reg(53, GetKeyRequest, _enc_get_key_req, _dec_get_key_req)
+
+    def _enc_get_key_reply(o, st, x):
+        parts: list = []
+        _enc_sel(parts, o.sel)
+        return b"".join(parts)
+
+    reg(
+        54, GetKeyReply,
+        _enc_get_key_reply,
+        lambda b, st: GetKeyReply(_dec_sel(b, 0)[0]),
+    )
 
 
 def _dec_get_value_req(b: bytes) -> GetValueRequest:

@@ -287,6 +287,18 @@ class Task(Future):
         # else: the spawn- or resume-queued _step is already in the heap and
         # will observe _cancelled before running any coroutine code
 
+    def __del__(self) -> None:
+        # a task whose loop was discarded before its first step (a cluster
+        # handed out a Database — which spawns its metrics emitter — and the
+        # test ended without running the loop again) holds a never-started
+        # coroutine; close it like cancel-before-start does, instead of
+        # leaking a "coroutine was never awaited" warning at GC
+        try:
+            if not self._started and not self.done():
+                self._coro.close()
+        except AttributeError:
+            pass  # partially-constructed task
+
 
 class EventLoop:
     """Virtual-clock, priority-ordered, deterministic run loop.

@@ -49,6 +49,12 @@ class ClientKnobs(Knobs):
         self.init("COMMIT_TIMEOUT", 5.0)
         # pause before re-picking a replica after a dead endpoint
         self.init("REROUTE_DELAY", 0.05)
+        # RYW SnapshotCache byte cap per transaction (client/
+        # snapshot_cache.py): prior reads at the transaction's read version
+        # are kept and re-served locally; past the cap the least-recently-
+        # touched known range is evicted (LRU-ish — the newest survivor
+        # never is, so an over-cap read still completes consistently)
+        self.init("RYW_CACHE_BYTES", 1 << 22)
 
 
 class CoreKnobs(Knobs):

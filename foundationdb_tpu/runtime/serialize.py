@@ -33,7 +33,11 @@ from typing import Any, Callable, Iterable
 # pre-tracing peer cannot decode sampled traffic, and the EXACT-match
 # hello means the pair severs once with a traced TransportProtocolMismatch
 # instead of looping on per-message decode failures when sampling turns on.
-PROTOCOL_VERSION = 0x0F_DB_71_02
+# ..03: key-selector resolution (tags 53/54, GetKeyRequest/GetKeyReply —
+# roles/types.py).  Low-byte bump for the same reason: existing traffic is
+# byte-identical, but a pre-selector peer meeting a getKey frame must
+# sever once at the hello, not per message.
+PROTOCOL_VERSION = 0x0F_DB_71_03
 
 
 class BinaryWriter:

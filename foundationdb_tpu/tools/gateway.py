@@ -35,6 +35,17 @@ Ops (body → reply body):
                                                  fdb_transaction_watch; use a
                                                  dedicated connection, the
                                                  simple bindings are serial)
+   15 GET_KEY      u64, sel                    → key (resolved; selector
+                                                 semantics in docs/API.md —
+                                                 offset overflow clamps to
+                                                 b"" / b"\\xff")
+   16 GET_RANGE_SELECTOR
+                   u64, bsel, esel, u32 limit  → u32 n, n × (key, val)
+
+    sel (a KeySelector):  key, u8 or_equal, i32 offset — the
+    first_greater_or_equal family resolved through the server-side
+    read-your-writes transaction, so a selector steps over keys this
+    transaction cleared and lands on keys it wrote.
 
 Status: 0 ok; 1 not_committed, 2 transaction_too_old, 3
 commit_unknown_result, 4 future_version, 5 timed_out, 6 bad request,
@@ -62,7 +73,8 @@ _HDR = struct.Struct("<QB")  # req_id, op
 # wire-protocol version, announced via GET_PROTOCOL (op 12): the multi-
 # version client (client/multiversion.py) probes it to select a matching
 # client implementation, the reference's currentProtocolVersion handshake
-PROTOCOL_VERSION = 1
+# v2: key selectors (GET_KEY op 15, GET_RANGE_SELECTOR op 16)
+PROTOCOL_VERSION = 2
 
 # the single source of truth for ABI status codes: the ABI constants AND
 # the vexillographer's generated table both derive from this dict
@@ -107,6 +119,16 @@ def _bstr(b: bytes, off: int) -> tuple[bytes, int]:
 def _wstr(out: bytearray, s: bytes) -> None:
     out += struct.pack("<I", len(s))
     out += s
+
+
+def _bsel(b: bytes, off: int):
+    """Parse one wire KeySelector: key (length-prefixed), u8 or_equal,
+    i32 offset."""
+    from ..roles.types import KeySelector
+
+    key, off = _bstr(b, off)
+    or_equal, offset = struct.unpack_from("<Bi", b, off)
+    return KeySelector(key, or_equal != 0, offset), off + 5
 
 
 class _GwConn:
@@ -288,6 +310,30 @@ class ClientGateway:
                         tr.set_option(opt, value or None)
                     except (ValueError, TypeError):
                         status = ERR_BAD_REQUEST
+                elif op == 15:  # GET_KEY (selector resolution, server-side
+                    # through the RYW merge — docs/API.md)
+                    sel, off = _bsel(body, off)
+                    try:
+                        resolved = await tr.get_key(sel)
+                    except (ValueError, TypeError):
+                        status = ERR_BAD_REQUEST
+                        resolved = b""
+                    if status == OK:
+                        _wstr(out, resolved)
+                elif op == 16:  # GET_RANGE_SELECTOR
+                    bsel, off = _bsel(body, off)
+                    esel, off = _bsel(body, off)
+                    limit, off = _u32(body, off)
+                    try:
+                        rows = await tr.get_range(bsel, esel, limit=limit)
+                    except (ValueError, TypeError):
+                        status = ERR_BAD_REQUEST
+                        rows = []
+                    if status == OK:
+                        out += struct.pack("<I", len(rows))
+                        for k, v in rows:
+                            _wstr(out, k)
+                            _wstr(out, v)
                 elif op == 14:  # WATCH (db-level: replies when key changes)
                     k, off = _bstr(body, off)
                     task = await self.db.watch(k)

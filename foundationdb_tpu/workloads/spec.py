@@ -49,6 +49,7 @@ from .device_fault import DeviceFaultWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
 from .readwrite import ReadWriteWorkload
+from .selector_oracle import SelectorOracleWorkload
 from .serializability import SerializabilityWorkload
 from .swizzle import SwizzleWorkload
 from .write_during_read import WriteDuringReadWorkload
@@ -68,6 +69,7 @@ WORKLOAD_FACTORY = {
     "Swizzle": SwizzleWorkload,
     "WriteDuringRead": WriteDuringReadWorkload,
     "DeviceFault": DeviceFaultWorkload,
+    "SelectorOracle": SelectorOracleWorkload,
 }
 
 # spec key -> RecoverableCluster kwarg

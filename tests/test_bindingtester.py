@@ -96,6 +96,14 @@ class _CtypesDriver:
             def atomic_add(self, k, d):
                 self.tr.atomic_add(k, d)
 
+            def get_key(self, k, or_equal, offset):
+                return self.tr.get_key(k, or_equal, offset)
+
+            def get_range_selector(self, bk, boe, boff, ek, eoe, eoff, limit):
+                return self.tr.get_range_selector(
+                    bk, boe, boff, ek, eoe, eoff, limit
+                )
+
             def commit(self):
                 self.tr.commit()
 
@@ -145,6 +153,26 @@ class _InProcessDriver:
 
                 tr.atomic_op(
                     MutationType.ADD, k, d.to_bytes(8, "little", signed=True)
+                )
+
+            def get_key(self, k, or_equal, offset):
+                from foundationdb_tpu.roles.types import KeySelector
+
+                return c.run_until(
+                    c.loop.spawn(tr.get_key(KeySelector(k, or_equal, offset))),
+                    300,
+                )
+
+            def get_range_selector(self, bk, boe, boff, ek, eoe, eoff, limit):
+                from foundationdb_tpu.roles.types import KeySelector
+
+                return c.run_until(
+                    c.loop.spawn(tr.get_range(
+                        KeySelector(bk, boe, boff),
+                        KeySelector(ek, eoe, eoff),
+                        limit=limit,
+                    )),
+                    300,
                 )
 
             def commit(self):
@@ -208,6 +236,8 @@ def _perlize(digest):
     for e in digest:
         if e[0] == "range":
             out.append(["range", _b64(e[1]), _b64(e[2]), e[3], _b64(e[4])])
+        elif e[0] in ("getkey", "rangesel"):
+            out.append([e[0], _b64(e[1])])
         elif e[0] == "top":
             out.append(["top", _b64(e[1])])
         elif e[0] == "stack":
@@ -239,6 +269,13 @@ def test_perl_binding_conforms(seed):
             wire_ops.append([kind, b64(op[1]), b64(op[2])])
         elif kind == "GET_RANGE":
             wire_ops.append([kind, b64(op[1]), b64(op[2]), op[3]])
+        elif kind == "GET_KEY":
+            # booleans as 0/1 ints: JSON::PP booleans don't survive a
+            # round-trip into perl pack() cleanly
+            wire_ops.append([kind, b64(op[1]), int(op[2]), op[3]])
+        elif kind == "GET_RANGE_SELECTOR":
+            wire_ops.append([kind, b64(op[1]), int(op[3]), op[4],
+                             b64(op[2]), int(op[5]), op[6], op[7]])
         elif kind == "ATOMIC_ADD":
             wire_ops.append([kind, b64(op[1]), op[2]])
         else:

@@ -47,6 +47,27 @@ def test_chaos_sweep_cycle_with_attrition(seed):
     c.stop()
 
 
+@pytest.mark.parametrize("seed", SWEEP_SEEDS)
+def test_chaos_sweep_selector_oracle(seed):
+    """The client-API referee (ROADMAP item #2): selector resolution and
+    cache-merged RYW reads must be byte-identical to a naive in-memory
+    oracle on EVERY seed, with attrition and swizzle clogging injecting
+    storage failovers, clogged links, and recoveries mid-transaction."""
+    from foundationdb_tpu.workloads.selector_oracle import SelectorOracleWorkload
+    from foundationdb_tpu.workloads.swizzle import SwizzleWorkload
+
+    c = RecoverableCluster(seed=seed + 40, n_storage_shards=2, chaos=True)
+    assert buggify.is_enabled()
+    w = SelectorOracleWorkload(rounds=3, checks_per_round=10)
+    att = AttritionWorkload(kills=1, interval=2.0, start_delay=1.3)
+    sw = SwizzleWorkload(rounds=2, victims=2, start_delay=0.6)
+    metrics = run_workloads(c, [w, att, sw], deadline=600.0)
+    assert metrics["SelectorOracle"]["divergences"] == 0
+    assert metrics["SelectorOracle"]["selector_checks"] >= 3
+    assert metrics["SelectorOracle"]["checks"] == 30
+    c.stop()
+
+
 def test_chaos_power_loss_restart():
     """Chaos + whole-cluster power loss: committed data survives restart."""
     from foundationdb_tpu.client.transaction import Database

@@ -31,8 +31,11 @@ from foundationdb_tpu.roles.types import (
     CommitTransactionRequest,
     GetCommitVersionReply,
     GetCommitVersionRequest,
+    GetKeyReply,
+    GetKeyRequest,
     GetKeyValuesReply,
     GetKeyValuesRequest,
+    KeySelector,
     GetRawCommittedVersionReply,
     GetRawCommittedVersionRequest,
     GetReadVersionReply,
@@ -198,6 +201,14 @@ BUILDERS = {
     GetKeyValuesReply: lambda r: GetKeyValuesReply(
         [(_rkey(r), _rkey(r)) for _ in range(r.randrange(5))],
         more=r.random() < 0.5,
+    ),
+    GetKeyRequest: lambda r: GetKeyRequest(
+        KeySelector(_rkey(r), r.random() < 0.5, r.randrange(-6, 7)),
+        r.randrange(100), _rkey(r), _rkey(r),
+        debug_id=r.choice([None, "", "gk"]),
+    ),
+    GetKeyReply: lambda r: GetKeyReply(
+        KeySelector(_rkey(r), r.random() < 0.5, r.randrange(-6, 7))
     ),
     WatchValueRequest: lambda r: WatchValueRequest(
         _rkey(r), r.choice([None, b"", _rkey(r)]), r.randrange(100)

@@ -1,0 +1,408 @@
+"""Key selectors end-to-end + the RYW SnapshotCache (ISSUE 8 acceptance).
+
+Selector resolution happens SERVER-side (roles/storage.py find_key, the
+storageserver.actor.cpp findKey walk) with shard-boundary continuation,
+and client-side over the merged (cache, writes) view in RYW; the
+SnapshotCache makes a read-twice transaction cost exactly one cluster
+fetch.  These tests pin the reference semantics: the four constructors,
+offset stepping across shard boundaries, boundary clamps (offset overflow
+resolves to b"" / b"\xff", never an error), or_equal against keys deleted
+in the same transaction's write set, cache hit/eviction behavior, and the
+observability surface (status + ClientMetrics)."""
+
+from foundationdb_tpu.client.ryw import ReadYourWritesTransaction
+from foundationdb_tpu.cluster import SimCluster
+from foundationdb_tpu.roles.types import (
+    CLIENT_KEYSPACE_END,
+    GetKeyReply,
+    GetKeyRequest,
+    KeySelector,
+)
+
+
+def run(c, coro, deadline=120.0):
+    return c.run_until(c.loop.spawn(coro), deadline)
+
+
+def _seed_keys(c, db, n=20):
+    async def seed():
+        tr = db.create_transaction()
+        for i in range(n):
+            tr.set(b"k%02d" % i, b"v%02d" % i)
+        await tr.commit()
+
+    run(c, seed())
+
+
+def _storage_reads(c) -> int:
+    return sum(ss.c_reads.value for ss in c.storage)
+
+
+# -- the four constructors + offset arithmetic (FDBTypes.h KeySelectorRef) ---
+
+
+def test_selector_constructors_resolve():
+    c = SimCluster(seed=801, n_storage_shards=2)
+    db = c.database()
+    _seed_keys(c, db)
+
+    async def main():
+        tr = db.create_transaction()
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k05")) == b"k05"
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k05\x00")) == b"k06"
+        assert await tr.get_key(KeySelector.first_greater_than(b"k05")) == b"k06"
+        assert await tr.get_key(KeySelector.last_less_or_equal(b"k05")) == b"k05"
+        assert await tr.get_key(KeySelector.last_less_or_equal(b"k05\x00")) == b"k05"
+        # offset 0 edge: the base position itself
+        assert await tr.get_key(KeySelector.last_less_than(b"k05")) == b"k04"
+        assert await tr.get_key(KeySelector.last_less_than(b"k00")) == b""
+        # arithmetic shifts the offset (KeySelectorRef::operator+)
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k05") + 3) == b"k08"
+        assert await tr.get_key(KeySelector.first_greater_than(b"k05") - 2) == b"k04"
+        return True
+
+    assert run(c, main())
+    c.stop()
+
+
+def test_selector_offsets_cross_shard_boundaries():
+    """Negative and positive offsets stepping past a shard edge continue on
+    the adjacent shard via the updated-selector reply (getKeyQ contract) —
+    and both shards actually served selector traffic."""
+    c = SimCluster(seed=802, n_storage_shards=3,
+                   storage_splits=[b"k05", b"k13"])
+    db = c.database()
+    _seed_keys(c, db)
+
+    async def main():
+        tr = db.create_transaction()
+        # forward across two boundaries: k02 + 14 keys -> k16
+        sel = KeySelector.first_greater_or_equal(b"k02") + 14
+        assert await tr.get_key(sel) == b"k16"
+        # backward across both boundaries: last < k17, back 13 -> k03
+        sel = KeySelector.last_less_than(b"k17") - 13
+        assert await tr.get_key(sel) == b"k03"
+        # backward selector anchored EXACTLY on a shard split routes left
+        assert await tr.get_key(KeySelector.last_less_than(b"k05")) == b"k04"
+        assert await tr.get_key(KeySelector.last_less_than(b"k13")) == b"k12"
+        return True
+
+    assert run(c, main())
+    assert sum(1 for ss in c.storage if ss.c_selector_reads.value > 0) >= 2, (
+        "selector walks never crossed a shard boundary"
+    )
+    c.stop()
+
+
+def test_selector_boundary_clamps():
+    """Before-begin / after-end resolutions clamp to the keyspace boundary
+    (allKeys.begin/end), never error — including large offset overflow."""
+    c = SimCluster(seed=803, n_storage_shards=2)
+    db = c.database()
+    _seed_keys(c, db, n=4)
+
+    async def main():
+        tr = db.create_transaction()
+        assert await tr.get_key(KeySelector.last_less_than(b"\x00")) == b""
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k00") - 100) == b""
+        assert await tr.get_key(KeySelector.first_greater_than(b"k03")) == CLIENT_KEYSPACE_END
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k00") + 100) == CLIENT_KEYSPACE_END
+        # anchors outside the user keyspace resolve, not raise
+        assert await tr.get_key(KeySelector.last_less_or_equal(b"\xfe")) == b"k03"
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"\xff")) == CLIENT_KEYSPACE_END
+        return True
+
+    assert run(c, main())
+    c.stop()
+
+
+def test_selector_get_range_endpoints():
+    c = SimCluster(seed=804, n_storage_shards=2)
+    db = c.database()
+    _seed_keys(c, db)
+
+    async def main():
+        tr = db.create_transaction()
+        rows = await tr.get_range(
+            KeySelector.first_greater_or_equal(b"k03"),
+            KeySelector.first_greater_than(b"k06"),
+        )
+        assert [k for k, _ in rows] == [b"k03", b"k04", b"k05", b"k06"]
+        # inverted resolution -> empty, not an error
+        rows = await tr.get_range(
+            KeySelector.first_greater_than(b"k06"),
+            KeySelector.first_greater_or_equal(b"k03"),
+        )
+        assert rows == []
+        return True
+
+    assert run(c, main())
+    c.stop()
+
+
+# -- RYW: selectors over the merged (cache, writes) view ---------------------
+
+
+def test_ryw_selector_sees_writes_and_deletes():
+    """or_equal on a key DELETED in this transaction's write set steps past
+    it; a key written this transaction is landable (RYWIterator merge)."""
+    c = SimCluster(seed=805, n_storage_shards=2)
+    db = c.database()
+    _seed_keys(c, db, n=10)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        tr.clear(b"k05")
+        # or_equal anchored on the deleted key: it no longer counts
+        assert await tr.get_key(KeySelector.last_less_or_equal(b"k05")) == b"k04"
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"k05")) == b"k06"
+        # a key written THIS transaction is a resolution target
+        tr.set(b"k045", b"x")
+        assert await tr.get_key(KeySelector.first_greater_than(b"k04")) == b"k045"
+        assert await tr.get_key(KeySelector.last_less_than(b"k05")) == b"k045"
+        # and selector ranges run over the same merged view
+        rows = await tr.get_range(
+            KeySelector.first_greater_or_equal(b"k04"),
+            KeySelector.first_greater_or_equal(b"k07"),
+        )
+        assert [k for k, _ in rows] == [b"k04", b"k045", b"k06"]
+        return True
+
+    assert run(c, main())
+    c.stop()
+
+
+def test_ryw_read_twice_is_one_storage_fetch():
+    """THE SnapshotCache acceptance: a repeated point read inside one
+    transaction issues exactly one cluster fetch (counted via storage-read
+    counters), and a covered range read re-serves from cache too."""
+    c = SimCluster(seed=806, n_storage_shards=2)
+    db = c.database()
+    _seed_keys(c, db)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        before = _storage_reads(c)
+        assert await tr.get(b"k07") == b"v07"
+        after_first = _storage_reads(c)
+        assert await tr.get(b"k07") == b"v07"
+        assert await tr.get(b"k07") == b"v07"
+        assert _storage_reads(c) == after_first, "repeat reads hit the cluster"
+        assert after_first - before == 1
+
+        # a range read populates the cache; point reads INSIDE the fetched
+        # window (hits and known-absent gaps) are free afterwards
+        rows = await tr.get_range(b"k10", b"k15")
+        assert len(rows) == 5
+        mark = _storage_reads(c)
+        assert await tr.get(b"k12") == b"v12"
+        assert await tr.get(b"k12\x00") is None      # known-empty gap
+        rows2 = await tr.get_range(b"k11", b"k14")   # sub-range
+        assert [k for k, _ in rows2] == [b"k11", b"k12", b"k13"]
+        assert _storage_reads(c) == mark, "cache-covered reads re-fetched"
+        return True
+
+    assert run(c, main())
+    stats = db.cache_stats.snapshot()
+    assert stats["cache_hits"] >= 4
+    assert stats["cache_inserts"] >= 2
+    c.stop()
+
+
+def test_ryw_cache_eviction_respects_byte_cap():
+    """RYW_CACHE_BYTES caps the per-transaction cache with LRU-ish
+    eviction: over-cap reads still complete and stay CORRECT, evictions
+    are counted, and live bytes stay bounded."""
+    from foundationdb_tpu.runtime.knobs import ClientKnobs
+
+    knobs = ClientKnobs()
+    knobs.RYW_CACHE_BYTES = 256
+    c = SimCluster(seed=807)
+    db = c.database()
+    db.knobs = knobs
+    _seed_keys(c, db, n=30)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        assert tr._cache.max_bytes == 256
+        for i in range(30):
+            assert await tr.get(b"k%02d" % i) == b"v%02d" % i
+        # re-reads remain correct whether evicted (re-fetch) or cached
+        for i in range(30):
+            assert await tr.get(b"k%02d" % i) == b"v%02d" % i
+        return True
+
+    assert run(c, main())
+    stats = db.cache_stats.snapshot()
+    assert stats["cache_evictions"] > 0, "cap never evicted"
+    assert stats["bytes"] <= 256
+    c.stop()
+
+
+def test_ryw_cache_cleared_on_reset_and_error():
+    """reset()/on_error() drop the cache with the write map: the retry
+    reads at a NEW version, so nothing cached may survive."""
+    c = SimCluster(seed=808)
+    db = c.database()
+    _seed_keys(c, db, n=4)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        await tr.get(b"k01")
+        assert tr._cache._segs
+        tr.reset()
+        assert not tr._cache._segs
+        await tr.get(b"k01")
+        from foundationdb_tpu.roles.types import NotCommitted
+
+        await tr.on_error(NotCommitted("forced"))
+        assert not tr._cache._segs
+        return True
+
+    assert run(c, main())
+    c.stop()
+
+
+# -- wire + observability -----------------------------------------------------
+
+
+def test_get_key_codec_roundtrip_and_protocol_bump():
+    from foundationdb_tpu.runtime.serialize import (
+        PROTOCOL_VERSION,
+        decode_payload,
+        encode_payload,
+    )
+
+    assert PROTOCOL_VERSION & 0xFF >= 0x03  # selector tags shipped
+    for msg in (
+        GetKeyRequest(KeySelector(b"a\x00b", True, -3), 17, b"", b"\xff",
+                      debug_id="d-1"),
+        GetKeyRequest(KeySelector(b"", False, 0), 0, b"a", b"b"),
+        GetKeyReply(KeySelector(b"\xff", True, 0)),
+        GetKeyReply(KeySelector(b"k", False, 12)),
+    ):
+        back = decode_payload(encode_payload(msg, strict=True))
+        assert back == msg, (msg, back)
+
+
+def test_cache_counters_in_cluster_status():
+    from foundationdb_tpu.control.status import cluster_status, validate_status
+
+    c = SimCluster(seed=809)
+    db = c.database()
+    _seed_keys(c, db, n=6)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        await tr.get(b"k01")
+        await tr.get(b"k01")
+        await tr.get_key(KeySelector.first_greater_or_equal(b"k00"))
+        return True
+
+    assert run(c, main())
+    doc = cluster_status(c)
+    validate_status(doc)
+    rc = doc["clients"]["ryw_cache"]
+    assert doc["clients"]["databases"] == 1
+    assert rc["cache_hits"] >= 1
+    assert rc["cache_inserts"] >= 1
+    assert rc["selector_reads"] >= 1
+    c.stop()
+
+
+def test_client_metrics_event_emitted():
+    """The periodic ClientMetrics trace event (the client-side slice of the
+    *Metrics plane) emits within one interval and validates against
+    ROLE_METRICS_SCHEMA."""
+    from foundationdb_tpu.control.status import validate_metrics_event
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+    knobs = CoreKnobs()
+    knobs.METRICS_INTERVAL = 0.5
+    c = SimCluster(seed=810, knobs=knobs)
+    db = c.database()
+    _seed_keys(c, db, n=4)
+
+    async def main():
+        tr = ReadYourWritesTransaction(db)
+        for _ in range(3):
+            await tr.get(b"k01")
+        await c.loop.delay(0.6)
+        return True
+
+    assert run(c, main())
+    evs = c.trace.find("ClientMetrics")
+    assert evs, "no ClientMetrics emitted"
+    for ev in evs:
+        validate_metrics_event(ev)
+    assert any(e["CacheHitsPerSec"] > 0 for e in evs)
+    c.stop()
+
+
+def test_selector_resolution_adds_conflict_range():
+    """A get_key read-conflicts on the span that DETERMINED the resolution
+    (getKeyAndConflictRange): a write landing inside it between read
+    version and commit aborts the transaction."""
+    from foundationdb_tpu.client.transaction import NotCommitted
+
+    c = SimCluster(seed=811)
+    db = c.database()
+    _seed_keys(c, db, n=6)
+
+    async def main():
+        tr = db.create_transaction()
+        # resolution (k02, k03]-dependent: first key > k02 is k03
+        assert await tr.get_key(KeySelector.first_greater_than(b"k02")) == b"k03"
+        # a concurrent commit inserts INTO the determining span
+        tr2 = db.create_transaction()
+        tr2.set(b"k02\x01", b"zap")
+        await tr2.commit()
+        tr.set(b"out", b"x")
+        try:
+            await tr.commit()
+            return False
+        except NotCommitted:
+            return True
+
+    assert run(c, main()), "selector read did not conflict-protect its span"
+    c.stop()
+
+
+def test_selector_walk_past_large_uncompacted_clear():
+    """A committed clear_range whose keys are still in the base store (the
+    overlay not yet folded) leaves >1000 DEAD base rows in the walk window;
+    find_key must re-fetch past a truncated base chunk instead of resolving
+    against a partial candidate set (regression: the walk used to cap its
+    base scan at need+1000 rows and silently skip the live key beyond)."""
+    from foundationdb_tpu.runtime.knobs import CoreKnobs
+
+    knobs = CoreKnobs()
+    knobs.STORAGE_DURABILITY_LAG = 5.0  # folds at fixed, avoidable ticks
+    c = SimCluster(seed=807, n_storage_shards=1, knobs=knobs)
+    db = c.database()
+
+    async def main():
+        for chunk in range(12):  # 1200 keys, committed in batches
+            tr = db.create_transaction()
+            for i in range(100):
+                j = chunk * 100 + i
+                tr.set(b"t%04d" % j, b"v")
+            await tr.commit()
+        tr = db.create_transaction()
+        tr.set(b"zz", b"end")
+        await tr.commit()
+        await c.loop.delay(11.0)  # >= 2 durability folds: keys now in base
+        tr = db.create_transaction()
+        tr.clear_range(b"t", b"u")  # dead in the overlay, live in the base
+        await tr.commit()
+        # read BEFORE the next fold (t ~= 11, next fold at 15): the walk
+        # crosses 1200 dead base rows and must land on the live key beyond
+        tr = db.create_transaction()
+        assert await tr.get_key(KeySelector.first_greater_or_equal(b"t")) == b"zz"
+        assert await tr.get_key(
+            KeySelector.first_greater_or_equal(b"t0500") + 2
+        ) == CLIENT_KEYSPACE_END  # zz +1, then clamp
+        return True
+
+    assert run(c, main())
+    c.stop()
