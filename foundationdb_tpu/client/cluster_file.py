@@ -74,9 +74,14 @@ def discover_gateway(path: str, timeout: float = 10.0) -> tuple[str, int]:
     """MonitorLeader for clients: read the cluster file, quorum-read the
     leader register, return the published (host, port) of the client
     gateway.  Raises TimedOut when no quorum answers or no leader is
-    published within `timeout`."""
-    import time as _time
+    published within `timeout`.
 
+    All pacing routes through the bound clock: the NetDriver anchors the
+    loop's virtual time to the wall, so `loop.now()` deadlines and
+    driver-driven `loop.delay()` backoffs replace raw monotonic reads —
+    and the retry backoff keeps PUMPING the network instead of blocking
+    the process in time.sleep (a late quorum reply now lands during the
+    backoff rather than after it)."""
     from ..control.coordination import CoordinatedState
     from ..rpc.transport import NetDriver, RealNetwork
     from ..runtime.core import EventLoop, TimedOut
@@ -92,20 +97,28 @@ def discover_gateway(path: str, timeout: float = 10.0) -> tuple[str, int]:
             owner=f"client-{os.getpid()}",
         )
         driver = NetDriver(loop, net)
-        deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
+
+        def backoff() -> None:
+            driver.run_until(loop.spawn(_delay_only(loop, 0.2)))
+
+        deadline = loop.now() + timeout
+        while loop.now() < deadline:
             fut = loop.spawn(cs.read())
             try:
                 value, _gen = driver.run_until(
-                    fut, wall_timeout=max(deadline - _time.monotonic(), 0.1)
+                    fut, wall_timeout=max(deadline - loop.now(), 0.1)
                 )
             except TimedOut:
-                _time.sleep(0.2)  # quorum unreachable: back off, re-dial
+                backoff()  # quorum unreachable: back off, re-dial
                 continue
             if value and "gateway" in value:
                 host, _, port = value["gateway"].rpartition(":")
                 return host, int(port)
-            _time.sleep(0.2)  # quorum up but no leader published yet
+            backoff()  # quorum up but no leader published yet
         raise TimedOut(f"no leader published by coordinators in {path}")
     finally:
         net.close()
+
+
+async def _delay_only(loop, seconds: float) -> None:
+    await loop.delay(seconds)

@@ -18,7 +18,7 @@ Layout (tuple-layer keys under the bucket prefix):
 from __future__ import annotations
 
 from .tuple_layer import Subspace
-from ..runtime.core import TaskPriority
+from ..runtime.core import ActorCancelled, TaskPriority
 
 
 def _pack_params(params: dict[bytes, bytes]) -> bytes:
@@ -134,6 +134,8 @@ class TaskBucketExecutor:
 
             try:
                 await self.db.run(fn)
+            except ActorCancelled:
+                raise  # stop() cancelled the worker: die, don't keep polling
             except Exception:  # noqa: BLE001 — cluster transient: retry
                 claimed = None
             if claimed is None:
@@ -149,6 +151,8 @@ class TaskBucketExecutor:
 
             try:
                 await self.db.run(done)
+            except ActorCancelled:
+                raise  # cancelled mid-finish: the lease re-queues the task
             except Exception:  # noqa: BLE001 — lease will re-queue it
                 pass
 

@@ -45,7 +45,10 @@ class SimCluster:
         self.rng = DeterministicRandom(seed)
         self.knobs = knobs or CoreKnobs()
         self.trace = TraceCollector(
-            clock=self.loop.now, min_severity=self.knobs.TRACE_SEVERITY
+            clock=self.loop.now, min_severity=self.knobs.TRACE_SEVERITY,
+            # sim trace files stamp VIRTUAL wall time: a seed's rolled
+            # trace output is byte-stable across reruns (flowlint wall-clock)
+            wall_clock=self.loop.now,
         )
         from .runtime.trace import g_trace_batch, spawn_wire_metrics
 

@@ -117,6 +117,9 @@ def _rec_search_iters() -> int:
     return max(1, min(n, 32))
 
 _IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort", "gather")}
+# literal env names (never f-string-built) so grep and flowlint's
+# knob-env-sync census can see every FDBTPU_* use
+_IMPL_ENV = {"search": "FDBTPU_SEARCH_IMPL", "merge": "FDBTPU_MERGE_IMPL"}
 
 
 def impl_from_env(kind: str, override: str | None = None) -> str:
@@ -128,7 +131,7 @@ def impl_from_env(kind: str, override: str | None = None) -> str:
     drift; unknown values fail loudly."""
     import os
 
-    v = override or os.environ.get(f"FDBTPU_{kind.upper()}_IMPL", "sort")
+    v = override or os.environ.get(_IMPL_ENV[kind], "sort")
     if v not in _IMPL_CHOICES[kind]:
         raise ValueError(
             f"unknown {kind}_impl {v!r}; choose one of {_IMPL_CHOICES[kind]}"

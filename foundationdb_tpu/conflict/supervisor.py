@@ -254,7 +254,7 @@ class DeviceSupervisor(ConflictSet):
         self.backoff0 = float(k.DEVICE_RETRY_BACKOFF)
         self.max_backoff = float(k.DEVICE_MAX_BACKOFF)
         self.reprobe_interval = float(k.DEVICE_REPROBE_INTERVAL)
-        self._clock = clock or time.monotonic
+        self._clock = clock or time.monotonic  # flowlint: ok wall-clock (real-network default; the resolver binds the sim clock under sim)
         self._watchdog = Watchdog(self.watchdog_s, wall=wall_watchdog)
 
         # committed-write-window record: [(version, ((b, e), ...)), ...]

@@ -46,7 +46,14 @@ from ..roles.types import (
 from ..rpc.network import Endpoint, SimNetwork, SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_all, wait_any
-from ..runtime.core import BrokenPromise, DeterministicRandom, EventLoop, TaskPriority, TimedOut
+from ..runtime.core import (
+    ActorCancelled,
+    BrokenPromise,
+    DeterministicRandom,
+    EventLoop,
+    TaskPriority,
+    TimedOut,
+)
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
 from ..runtime.coverage import testcov
@@ -1312,6 +1319,8 @@ class ClusterController:
         async def kick() -> None:
             try:
                 await self._recover()
+            except ActorCancelled:
+                raise  # a deposed controller's kick must die, not log
             except Exception as e:  # noqa: BLE001 — monitor retries later
                 self.trace.trace("MasterRecoveryError", Error=repr(e), Epoch=self.epoch)
 
@@ -1340,6 +1349,8 @@ class ClusterController:
             tr = db.create_transaction()
             try:
                 rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
+            except ActorCancelled:
+                raise  # stop() cancelled the watch: exit, don't zombie-poll
             except Exception:  # noqa: BLE001 — recovery window; retry next tick
                 continue
             parsed = parse_conf_rows(rows)
@@ -1387,6 +1398,8 @@ class ClusterController:
                         self.trace.trace(
                             "CoordinatorsChanged", Count=coord_n, Epoch=self.epoch
                         )
+                except ActorCancelled:
+                    raise  # cancelled mid-change: the watch is being torn down
                 except Exception as e:  # noqa: BLE001 — next poll retries
                     self.trace.trace("CoordinatorsChangeError", Error=repr(e))
 
@@ -1405,6 +1418,8 @@ class ClusterController:
                 testcov("management.exclusion_recovery")
                 try:
                     await self._recover()
+                except ActorCancelled:
+                    raise  # a deposed watcher must not keep recovering
                 except Exception:  # noqa: BLE001 — next poll retries
                     pass
                 continue
@@ -1467,13 +1482,13 @@ class ClusterController:
             )
             try:
                 await self._recover()
+            except ActorCancelled:
+                raise  # teardown, not a failed reconfiguration
             except Exception:  # noqa: BLE001 — next poll re-detects the
                 continue       # actual-vs-desired mismatch and retries
 
     async def _redundancy_step(self, policy) -> None:
         """One replica-change step, off the conf watch's critical path."""
-        from ..runtime.core import ActorCancelled
-
         try:
             await self.on_redundancy_change(policy)
         except ActorCancelled:
@@ -1509,6 +1524,8 @@ class ClusterController:
                 testcov("recovery.triggered")
                 try:
                     await self._recover()
+                except ActorCancelled:
+                    raise  # a superseded monitor must die with its epoch
                 except Exception as e:  # noqa: BLE001 — transient quorum
                     # loss etc. must not kill the monitor: log and retry on
                     # the next heartbeat tick

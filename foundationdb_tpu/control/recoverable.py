@@ -63,6 +63,13 @@ class RecoverableCluster:
                                 # 0 = roles constructed directly
         trace_sink=None,        # file-like: trace events stream to it as
                                 # JSONL (the reference's rolling trace files)
+        trace_wall_clock=None,  # WallTime source for trace-file lines.
+                                # None = the loop's virtual clock, so a
+                                # seed's rolled traces are byte-stable
+                                # across reruns (per-seed soak capture);
+                                # a REAL deployment (tools/server.py)
+                                # passes the host wall — cross-process
+                                # trace joins need one shared clock
         debug_sample_rate: float = 0.0,  # fraction of every database()'s
                                 # transactions given a pipeline-timeline
                                 # debug ID (g_traceBatch sampling) — the
@@ -108,6 +115,7 @@ class RecoverableCluster:
         self.trace = TraceCollector(
             clock=self.loop.now, sink=trace_sink,
             min_severity=self.knobs.TRACE_SEVERITY,
+            wall_clock=trace_wall_clock or self.loop.now,
         )
         self.debug_sample_rate = debug_sample_rate
         self.client_dbs: list = []

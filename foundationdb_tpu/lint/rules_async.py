@@ -1,0 +1,161 @@
+"""Actor-discipline rules: the Flow actor compiler's checks.
+
+dropped-future    a statement-level call to a known-async callable whose
+                  coroutine is neither awaited, spawned, stored, nor
+                  returned — Flow's "discarded Future" compile error
+                  (flow/actorcompiler/ActorCompiler.cs).  The coroutine
+                  object would silently never run.
+swallowed-cancel  an `except:` / `except Exception:` / `except
+                  BaseException:` inside a coroutine, around an await,
+                  that can eat ActorCancelled without re-raising.  This
+                  runtime's ActorCancelled inherits Exception (the
+                  reference's actor_cancelled is a plain Error too), so a
+                  broad handler turns a cancelled actor into a zombie that
+                  keeps running past its cancellation point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from . import Finding, LintContext, Rule, SourceFile, contains_await, walk_with_async
+
+
+class DroppedFutureRule(Rule):
+    id = "dropped-future"
+    hint = ("await it, loop.spawn(...) it, or bind it — a bare call to an "
+            "async def builds a coroutine that never runs")
+
+    def check_file(self, sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+        # Matching is deliberately conservative — three resolvable shapes
+        # (no cross-file attribute guessing, so `items.remove(x)` can never
+        # collide with an unrelated `async def remove` elsewhere):
+        #   1. `self.m()` where the enclosing class defines `async def m`
+        #   2. `name()` where `name` is an async def in THIS file (and not
+        #      also a sync def — a test's dropped `async def go` is dead too)
+        #   3. `name()` where `name` was imported from a package module and
+        #      is async-only package-wide
+        local_async = {
+            n.name for n in ast.walk(sf.tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+        }
+        local_sync = {
+            n.name for n in ast.walk(sf.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        imported = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and (
+                node.level > 0 or (node.module or "").startswith("foundationdb_tpu")
+            ):
+                for a in node.names:
+                    if a.name in ctx.async_only_defs:
+                        imported.add(a.asname or a.name)
+        bare_known = (local_async - local_sync) | (imported - local_sync)
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Expr) or not isinstance(node.value, ast.Call):
+                continue
+            fn = node.value.func
+            if isinstance(fn, ast.Name) and fn.id in bare_known:
+                yield self.finding(
+                    sf, node.lineno,
+                    f"result of async callable {fn.id!r} is dropped "
+                    f"(coroutine constructed but never awaited/spawned)")
+
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name for n in cls.body if isinstance(n, ast.AsyncFunctionDef)
+            }
+            if not methods:
+                continue
+            for node in ast.walk(cls):
+                if (
+                    isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and isinstance(node.value.func.value, ast.Name)
+                    and node.value.func.value.id == "self"
+                    and node.value.func.attr in methods
+                ):
+                    yield self.finding(
+                        sf, node.lineno,
+                        f"result of async method "
+                        f"'self.{node.value.func.attr}' is dropped "
+                        f"(coroutine constructed but never awaited/spawned)")
+
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return bool(set(names) & _BROAD)
+
+
+def _handles_cancel(handler: ast.ExceptHandler) -> bool:
+    """A handler is fine if it re-raises (any `raise`) or visibly deals
+    with ActorCancelled (isinstance check / re-wrap / mention)."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Name) and n.id == "ActorCancelled":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "ActorCancelled":
+            return True
+    return False
+
+
+def _body_exits(handler: ast.ExceptHandler) -> bool:
+    """Does the handler BODY re-raise or return?  (For a dedicated
+    `except ActorCancelled:` handler, mentioning the name is not enough —
+    its own type node mentions it — the body must actually stop the
+    actor: `raise` propagates the cancel, `return` ends the coroutine.)"""
+    for stmt in handler.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Raise, ast.Return)):
+                return True
+    return False
+
+
+class SwallowedCancelRule(Rule):
+    id = "swallowed-cancel"
+    hint = ("add `except ActorCancelled: raise` above the broad handler, "
+            "or re-raise inside it")
+
+    def check_file(self, sf: SourceFile, ctx: LintContext) -> Iterable[Finding]:
+        if sf.scope != "package":
+            return  # tests drive the loop from outside; their broad
+            # handlers assert on failures rather than hiding a cancel
+        for node, in_async in walk_with_async(sf.tree):
+            if not isinstance(node, ast.Try) or not in_async:
+                continue
+            if not contains_await(
+                ast.Module(body=node.body, type_ignores=[])
+            ):
+                continue  # no await point in the try body: cannot see cancel
+            for h in node.handlers:
+                if isinstance(h.type, ast.Name) and h.type.id == "ActorCancelled":
+                    if not _body_exits(h):
+                        yield self.finding(
+                            sf, h.lineno,
+                            "dedicated `except ActorCancelled:` neither "
+                            "re-raises nor returns (cancelled actor keeps "
+                            "running)",
+                            hint="re-raise (or return) inside the handler")
+                    break  # a dedicated handler shields later broad ones
+                if _catches_broad(h) and not _handles_cancel(h):
+                    yield self.finding(
+                        sf, h.lineno,
+                        "broad except around an await can swallow "
+                        "ActorCancelled (cancelled actor keeps running)")

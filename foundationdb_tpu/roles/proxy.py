@@ -356,6 +356,9 @@ class CommitProxy:
         self._inflight += 1
         try:
             await self._commit_batch_inner(batch)
+        # flowlint: ok swallowed-cancel (deliberate: stop() cancels in-flight
+        # batches and the cancelled batch MUST answer UNKNOWN — a deposed
+        # proxy's clients run the fence dance, not a hang; see stop())
         except Exception as e:  # noqa: BLE001 — containment: ANY commit-path
             # failure (not just TimedOut) must answer the clients and, since
             # an assigned version may now be a hole in the prev->version

@@ -551,17 +551,17 @@ class WallDriver:
             for p in self.pumps
             if (flush := getattr(getattr(p, "__self__", None), "flush_queued", None))
         ]
-        self._origin = _time.monotonic() - loop.now()
+        self._origin = _time.monotonic() - loop.now()  # flowlint: ok wall-clock (the wall driver anchors virtual time to the wall)
 
     def _tick(self) -> None:
         """One reactor turn: drain every due timer, spend the gap until the
         next one polling the reactors, and anchor virtual time to the wall
         (run_one never moves time backwards, so the anchor is always safe —
         the single place this time model lives for the real-IO driver)."""
-        now = _time.monotonic()
+        now = _time.monotonic()  # flowlint: ok wall-clock (wall driver tick)
         while self.loop._heap and self._origin + self.loop._heap[0][0] <= now:
             self.loop.run_one()
-            now = _time.monotonic()
+            now = _time.monotonic()  # flowlint: ok wall-clock (wall driver tick)
         # cross-reactor flush barrier: frames the timer turn just queued on
         # ANY net go out before the FIRST net sleeps on its poll
         for flush in self._flushers:
@@ -572,20 +572,20 @@ class WallDriver:
         share = gap / max(len(self.pumps), 1)
         for pump in self.pumps:
             pump(share)
-        self.loop._now = max(self.loop._now, _time.monotonic() - self._origin)
+        self.loop._now = max(self.loop._now, _time.monotonic() - self._origin)  # flowlint: ok wall-clock (the anchor itself)
 
     def run_until(self, fut: Future, wall_timeout: float | None = None) -> Any:
-        start = _time.monotonic()
+        start = _time.monotonic()  # flowlint: ok wall-clock (wall_timeout is a host bound by contract)
         while not fut.done():
-            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:
+            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:  # flowlint: ok wall-clock (wall_timeout is a host bound by contract)
                 raise TimedOut(f"wall timeout {wall_timeout}s")
             self._tick()
         return fut.result()
 
     def serve_forever(self, wall_timeout: float | None = None) -> None:
         """Pump IO + timers until the deadline (server main loop)."""
-        start = _time.monotonic()
-        while wall_timeout is None or _time.monotonic() - start < wall_timeout:
+        start = _time.monotonic()  # flowlint: ok wall-clock (server main-loop deadline is host wall)
+        while wall_timeout is None or _time.monotonic() - start < wall_timeout:  # flowlint: ok wall-clock (server main-loop deadline is host wall)
             self._tick()
 
 

@@ -423,20 +423,20 @@ class RealClockDriver:
 
     def __init__(self, loop: EventLoop) -> None:
         self.loop = loop
-        self._origin = _time.monotonic() - loop.now()
+        self._origin = _time.monotonic() - loop.now()  # flowlint: ok wall-clock (the real-clock driver IS the wall)
 
     def run_until(self, fut: Future, wall_timeout: float | None = None) -> Any:
-        start = _time.monotonic()
+        start = _time.monotonic()  # flowlint: ok wall-clock (wall_timeout is a host bound by contract)
         while not fut.done():
-            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:
+            if wall_timeout is not None and _time.monotonic() - start > wall_timeout:  # flowlint: ok wall-clock (wall_timeout is a host bound by contract)
                 raise TimedOut(f"wall timeout {wall_timeout}s")
             if not self.loop._heap:
                 raise RuntimeError("deadlock: no runnable tasks but future pending")
             due = self.loop._heap[0][0]
             wall_due = self._origin + due
-            delta = wall_due - _time.monotonic()
+            delta = wall_due - _time.monotonic()  # flowlint: ok wall-clock (mapping virtual timers onto the wall)
             if delta > 0:
-                _time.sleep(min(delta, 0.05))
+                _time.sleep(min(delta, 0.05))  # flowlint: ok wall-clock (the production sleep-until-due loop)
                 continue
             self.loop.run_one()
         return fut.result()

@@ -9,6 +9,43 @@ from __future__ import annotations
 from typing import Any
 
 
+# Environment-variable knobs — the process-level switches that exist
+# OUTSIDE the typed Knobs registries below (they gate backend/pipeline
+# selection before any cluster object exists, so they ride the
+# environment like the reference's command-line --knob_ overrides).
+# flowlint's knob-env-sync rule keeps this registry two-way honest: every
+# `FDBTPU_*` string literal in the tree must appear here, and every entry
+# here must be used somewhere.  KNOBS.md renders this table
+# (tools/vexillographer.py).
+ENV_KNOBS: dict[str, str] = {
+    "FDBTPU_PIPELINE": "opt into the split-phase resolver pipeline "
+                       "(conflict/pipeline.py; 0/1, default off)",
+    "FDBTPU_PALLAS": "Pallas kernel path selection: auto/compiled/interpret/"
+                     "off (conflict/pallas_kernel.py)",
+    "FDBTPU_INCREMENTAL": "opt out of the incremental LSM device state "
+                          "layout with =0 (conflict/device.py)",
+    "FDBTPU_LSM": "recent-window LSM layout override for the device "
+                  "backend (conflict/device.py)",
+    "FDBTPU_MERGE_IMPL": "device merge implementation override "
+                         "(conflict/device.py)",
+    "FDBTPU_SEARCH_IMPL": "device search implementation override "
+                          "(conflict/device.py)",
+    "FDBTPU_REC_ITERS": "fixed-point iteration override for the recurrence "
+                        "search fold (conflict/device.py)",
+    "FDBTPU_PHASE_TIMING": "=1 populates per-phase kernel wall times with a "
+                           "sync per phase (conflict/api.py)",
+    "FDBTPU_FORCE_DEGRADE": "=1 boots the DeviceSupervisor directly in "
+                            "degraded CPU mode (conflict/supervisor.py)",
+    "FDBTPU_SOAK_SEEDS": "seed-matrix width for the chaos sweeps "
+                         "(tests/test_chaos_sweep.py; CI default 5)",
+    "FDBTPU_SOAK_FORCE_FAIL": "soak triage demo hook: fail this seed after "
+                              "its run so the failure carries a full trace "
+                              "(tools/soak.py)",
+    "FDBTPU_SOAK_DEVICE": "=1 lets a soak campaign's seed subprocesses use "
+                          "the device backend (tools/soak.py)",
+}
+
+
 class Knobs:
     """A bag of typed knobs.  Subclasses declare defaults in __init__ via
     self.init(name, value, randomize=fn) and users override by attribute or

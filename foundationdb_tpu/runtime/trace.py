@@ -126,8 +126,20 @@ class TraceCollector:
     def __init__(self, clock: Callable[[], float] | None = None,
                  sink=None, keep: int = 50000,
                  min_severity: int = SEV_DEBUG,
-                 machine: str | None = None) -> None:
+                 machine: str | None = None,
+                 wall_clock: Callable[[], float] | None = None) -> None:
         self._clock = clock or (lambda: 0.0)
+        # the clock behind the file lines' WallTime stamp.  Real processes
+        # keep the default (cross-process trace joins need a SHARED clock,
+        # which only the host wall provides); deterministic sim clusters
+        # bind their virtual clock instead, so a seed's rolled trace files
+        # are byte-stable across reruns — same discipline as the reference,
+        # where sim trace time is g_network->now().  The one sanctioned
+        # exception is SlowTask: its DurationS payload is a HOST-wall
+        # measurement of a reactor stall (runtime/core.py) — profiling
+        # data virtual time cannot see — so those lines may differ
+        # between reruns (tests/test_flowlint.py pins the carve-out)
+        self._wall_clock = wall_clock or _time.time  # flowlint: ok wall-clock (default for real processes; sim binds the sim clock)
         self._sink = sink  # TextIO or TraceFileSink: anything with write(str)
         self.min_severity = min_severity
         self.machine = machine
@@ -153,7 +165,8 @@ class TraceCollector:
             # carry wall time
             try:
                 self._sink.write(
-                    json.dumps({**ev, "WallTime": _time.time()}, default=str)
+                    json.dumps({**ev, "WallTime": self._wall_clock()},
+                               default=str)
                     + "\n"
                 )
             except OSError:

@@ -342,11 +342,18 @@ class Cli:
 
 def main() -> None:
     # batch subcommands ride the same entry point as the REPL (fdbcli's
-    # --exec flavor): `cli soak SPEC ...` runs a soak campaign and exits
+    # --exec flavor): `cli soak SPEC ...` runs a soak campaign and exits;
+    # `cli lint [paths...]` runs the flowlint static pass (docs/LINT.md)
     if len(sys.argv) > 1 and sys.argv[1] == "soak":
         from .soak import main as soak_main
 
         sys.exit(soak_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "lint":
+        from .flowlint import main as lint_main
+
+        # flowlint itself defaults to the full tree when no paths are
+        # given, so flag-only invocations (`cli lint --json`) work too
+        sys.exit(lint_main(sys.argv[2:]))
     Cli().repl()
 
 

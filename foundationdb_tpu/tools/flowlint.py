@@ -1,0 +1,91 @@
+"""flowlint CLI — the actor-discipline static analyzer (docs/LINT.md).
+
+    python -m foundationdb_tpu.tools.flowlint foundationdb_tpu tests
+
+Exit 0 only when every finding is fixed, suppressed with a reasoned
+`# flowlint: ok <rule> (...)`, or grandfathered in the committed baseline
+AND no baseline entry has gone stale (zero-or-fail in both directions —
+the ratchet can only tighten).  Also reachable as `cli lint`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..lint import (
+    apply_baseline,
+    default_rules,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+# repo root: tools/ -> foundationdb_tpu/ -> the checkout
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".flowlint-baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flowlint", description="actor-discipline static analyzer")
+    ap.add_argument("paths", nargs="*", help="files/directories to lint")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="paths in findings/baseline are relative to this")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                         f"when it exists)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the current findings and exit 0")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:18s} {r.hint}")
+        return 0
+    if not args.paths:
+        # the documented default surface — also what a bare `cli lint`
+        # means, whatever flags ride along
+        args.paths = [os.path.join(REPO_ROOT, "foundationdb_tpu"),
+                      os.path.join(REPO_ROOT, "tests")]
+
+    findings = run_lint(args.paths, root=args.root, rules=rules)
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    if args.write_baseline:
+        save_baseline(baseline_path or DEFAULT_BASELINE, findings)
+        print(f"flowlint: baselined {len(findings)} findings into "
+              f"{baseline_path or DEFAULT_BASELINE}")
+        return 0
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    new, old, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in new],
+            "baselined": [f.__dict__ for f in old],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for b in stale:
+            print(f"{b['path']}:{b['line']}: [{b['rule']}] STALE baseline "
+                  f"entry — the site no longer trips the rule; delete it "
+                  f"from {baseline_path}")
+        print(f"flowlint: {len(new)} new finding(s), {len(old)} baselined, "
+              f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"({len(rules)} rules)")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,7 +65,7 @@ from ..client.transaction import (
 )
 from ..roles.types import FutureVersion, MutationType, TransactionTooOld
 from ..rpc.transport import WallDriver
-from ..runtime.core import EventLoop, TaskPriority, TimedOut
+from ..runtime.core import ActorCancelled, EventLoop, TaskPriority, TimedOut
 
 _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<QB")  # req_id, op
@@ -352,6 +352,8 @@ class ClientGateway:
                 else:
                     status = ERR_BAD_REQUEST
             self._reply(conn, req_id, status, bytes(out))
+        except ActorCancelled:
+            raise  # gateway teardown: don't answer from a dying handler
         except Exception as e:  # noqa: BLE001 — errors become status codes
             for etype, code in _ERR_CODE.items():
                 if isinstance(e, etype):

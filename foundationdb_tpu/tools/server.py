@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 
 def main(argv=None) -> None:
@@ -125,6 +126,9 @@ def main(argv=None) -> None:
         storage_engine=args.engine,
         n_workers=args.workers,
         trace_sink=sink,
+        # a real process stamps trace WallTime from the HOST wall: clients
+        # and coordservers join this server's trace files on that clock
+        trace_wall_clock=time.time,  # flowlint: ok wall-clock (cross-process trace joins share the host wall)
         knobs=knobs,
         **extra,
     )
@@ -183,10 +187,14 @@ def main(argv=None) -> None:
             raise RuntimeError("could not publish gateway to coordinators")
 
         async def reassert() -> None:
+            from ..runtime.core import ActorCancelled
+
             while True:
                 await cluster.loop.delay(2.0)
                 try:
                     await publish_once()
+                except ActorCancelled:
+                    raise  # server shutdown: stop re-asserting leadership
                 except Exception:  # noqa: BLE001 — quorum down: next period
                     pass
 

@@ -31,6 +31,7 @@ rendered markdown) carries:
         [--seed-deadline 300] [--sample-rate 1.0] [--keep-traces]
 """
 
+# flowlint: file ok wall-clock (campaign driver: seed deadlines and wall_s are host wall by design; determinism lives inside each seed subprocess)
 from __future__ import annotations
 
 import json

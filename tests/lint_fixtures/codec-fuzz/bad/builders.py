@@ -1,0 +1,7 @@
+class BarMsg:
+    pass
+
+
+BUILDERS = {
+    BarMsg: lambda r: BarMsg(),  # stale: BarMsg is registered nowhere
+}

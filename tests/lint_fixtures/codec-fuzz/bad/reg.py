@@ -1,0 +1,8 @@
+from foundationdb_tpu.runtime import serialize as _wire
+
+
+class FooMsg:
+    pass
+
+
+_wire.register_codec(200, FooMsg, None, None)

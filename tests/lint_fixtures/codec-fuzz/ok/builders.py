@@ -1,0 +1,5 @@
+from .reg import FooMsg
+
+BUILDERS = {
+    FooMsg: lambda r: FooMsg(),
+}

@@ -1,0 +1,9 @@
+from foundationdb_tpu.runtime import serialize as _wire
+
+
+class FooMsg:
+    pass
+
+
+reg = _wire.register_codec
+reg(200, FooMsg, None, None)

@@ -1,0 +1,14 @@
+from foundationdb_tpu.runtime.coverage import testcov
+from foundationdb_tpu.runtime.buggify import buggify
+
+
+def a():
+    testcov("fixture.dup_site")
+
+
+def b():
+    testcov("fixture.dup_site")  # duplicate merges two census rows
+
+
+def c():
+    testcov("buggify.shadowed")  # shadows the buggify mirror namespace

@@ -1,0 +1,11 @@
+from foundationdb_tpu.runtime.coverage import testcov
+from foundationdb_tpu.runtime.buggify import buggify
+
+
+def a():
+    testcov("fixture.site_a")
+
+
+def b():
+    if buggify("fixture.site_b"):
+        testcov("fixture.site_b_armed")

@@ -1,0 +1,14 @@
+class Worker:
+    async def flush_all(self):
+        return 1
+
+    def kick(self):
+        self.flush_all()  # coroutine constructed, never awaited
+
+
+async def helper():
+    return 2
+
+
+def run():
+    helper()  # dropped local async def

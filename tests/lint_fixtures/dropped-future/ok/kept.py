@@ -1,0 +1,19 @@
+class Worker:
+    async def flush_all(self):
+        return 1
+
+    async def kick(self):
+        await self.flush_all()
+
+    def kick_bg(self, loop):
+        return loop.spawn(self.flush_all())
+
+
+async def helper():
+    return 2
+
+
+def run(loop):
+    loop.spawn(helper())
+    t = helper()
+    return t

@@ -1,0 +1,6 @@
+# annotated assignment on purpose: the real registry (runtime/knobs.py)
+# is an AnnAssign, which the anchor scan once silently missed
+ENV_KNOBS: dict[str, str] = {
+    "FDBTPU_GOOD": "a registered and used knob",
+    "FDBTPU_DEAD": "registered but used nowhere",
+}

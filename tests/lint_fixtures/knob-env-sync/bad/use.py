@@ -1,0 +1,8 @@
+import os
+
+
+def knobs():
+    return (
+        os.environ.get("FDBTPU_GOOD"),
+        os.environ.get("FDBTPU_ROGUE"),  # unregistered
+    )

@@ -1,0 +1,5 @@
+# annotated assignment on purpose: the real registry (runtime/knobs.py)
+# is an AnnAssign, which the anchor scan once silently missed
+ENV_KNOBS: dict[str, str] = {
+    "FDBTPU_GOOD": "a registered and used knob",
+}

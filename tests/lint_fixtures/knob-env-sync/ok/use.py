@@ -1,0 +1,5 @@
+import os
+
+
+def knobs():
+    return os.environ.get("FDBTPU_GOOD")

@@ -1,0 +1,11 @@
+from foundationdb_tpu.runtime.trace import spawn_role_metrics
+
+# annotated assignment on purpose: the real registry (control/status.py)
+# is an AnnAssign, which the anchor scan once silently missed
+ROLE_METRICS_SCHEMA: dict = {
+    "FixGoodMetrics": {},
+}
+
+
+def start(loop, proc, trace, fields):
+    spawn_role_metrics(loop, proc, trace, "FixGoodMetrics", fields, 1.0)

@@ -1,0 +1,3 @@
+X = 1  # flowlint: ok wall-clock
+# flowlint: ok no-such-rule (naming a rule that does not exist)
+Y = 2

@@ -1,0 +1,3 @@
+import time
+
+Z = time.time()  # flowlint: ok wall-clock (fixture: a reasoned, known-rule pragma)

@@ -1,0 +1,18 @@
+async def poll(db, loop):
+    while True:
+        try:
+            await db.run()
+        except Exception:
+            pass  # eats ActorCancelled: the actor keeps polling
+        await loop.delay(1.0)
+
+
+async def fake_shield(db, loop):
+    while True:
+        try:
+            await db.run()
+        except ActorCancelled:
+            pass  # swallows the cancel itself: the actor keeps polling
+        except Exception:
+            pass  # shielded from the rule, but the handler above fires
+        await loop.delay(1.0)

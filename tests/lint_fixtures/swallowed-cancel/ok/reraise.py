@@ -1,0 +1,34 @@
+from foundationdb_tpu.runtime.core import ActorCancelled
+
+
+async def poll(db, loop):
+    while True:
+        try:
+            await db.run()
+        except ActorCancelled:
+            raise
+        except Exception:
+            pass  # shielded by the dedicated handler above
+        await loop.delay(1.0)
+
+
+async def recording(db, fut):
+    try:
+        await db.run()
+    except ActorCancelled as e:
+        fut.set_error(e)
+        return  # ends the coroutine: visible handling, not a zombie
+
+
+async def reraising(db):
+    try:
+        await db.run()
+    except Exception:
+        raise  # transforming but re-raising is visible handling
+
+
+def sync_helper(items):
+    try:
+        items.validate()
+    except Exception:
+        return None  # no await in the try: cancel cannot land here

@@ -1,0 +1,2 @@
+async def work(loop):
+    await loop.delay(0.1)

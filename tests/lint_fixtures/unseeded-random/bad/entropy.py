@@ -1,0 +1,17 @@
+import os
+import random
+
+
+def draw():
+    return random.random() + random.randrange(5)
+
+
+def salt():
+    return os.urandom(8)
+
+
+def census(items):
+    out = []
+    for x in set(items):  # hash-ordered iteration
+        out.append(x)
+    return out + [y for y in {1, 2, 3}]

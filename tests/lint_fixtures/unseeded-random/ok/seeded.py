@@ -1,0 +1,13 @@
+import random
+
+
+def draw(seed):
+    return random.Random(seed).random()
+
+
+def census(items):
+    return [x for x in sorted(set(items))]
+
+
+def member(items, x):
+    return x in set(items)  # membership is order-free

@@ -1,0 +1,15 @@
+import time
+from datetime import datetime
+
+
+def stamp():
+    return time.time()
+
+
+def pace():
+    time.sleep(0.1)
+    return time.monotonic()
+
+
+def day():
+    return datetime.now()

@@ -1,0 +1,13 @@
+import time
+
+
+def stamp(loop):
+    return loop.now()
+
+
+def phase_wall():
+    return time.perf_counter()  # observability timers are host wall by design
+
+
+def probe_budget():
+    time.sleep(0.01)  # flowlint: ok wall-clock (fixture: reasoned suppression silences the rule)

@@ -1,0 +1,12 @@
+SEV_WARN = 20
+
+WARN_EVENT_TYPES = frozenset({
+    "FixtureRegistered",
+    "FixtureStale",  # no call site anywhere
+})
+
+
+def emit(trace):
+    trace.trace("FixtureRogue", severity=SEV_WARN)
+    trace.trace("FixtureRegistered", severity=SEV_WARN)
+    trace.trace("FixtureRegistered", severity=SEV_WARN)  # second site

@@ -1,0 +1,10 @@
+SEV_WARN = 20
+
+WARN_EVENT_TYPES = frozenset({
+    "FixtureRegistered",
+})
+
+
+def emit(trace):
+    trace.trace("FixtureRegistered", severity=SEV_WARN)
+    trace.trace("FixtureInfoOnly")

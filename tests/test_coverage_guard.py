@@ -1,103 +1,51 @@
-"""Coverage-site guard rails (the WARN-event-guard discipline applied to
-the testcov/buggify namespace): every literal `testcov("...")` /
-`buggify("...")` / `maybe_delay(loop, "...")` site string in the package
-is unique — one name, one call site, so a census row can never silently
-aggregate two different code paths — and every required-coverage manifest
-(tests/specs/*.coverage, tools/soak.py convention) references only sites
-that actually exist in the tree."""
+"""Coverage-site guard rails — MIGRATED into flowlint (PR 9).
+
+The AST walker that lived here (site-string uniqueness, the `buggify.`
+mirror-namespace shadow check, manifest-references-real-sites, and the
+manifest/spec pairing convention) is now the `coverage-sites` rule in
+foundationdb_tpu/lint/rules_registry.py, sharing one parse per file with
+every other rule and running in the tier-1 flowlint gate
+(tests/test_flowlint.py::test_committed_baseline_is_fresh).
+
+This wrapper is what the migration left behind: it proves the rule still
+FIRES on the known-bad fixture, so the guard cannot silently rot even if
+the tier-1 gate's tree happens to be clean."""
 
 from __future__ import annotations
 
-import ast
 import pathlib
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "foundationdb_tpu"
-SPEC_DIR = pathlib.Path(__file__).resolve().parent / "specs"
+from foundationdb_tpu.lint import run_lint
+from foundationdb_tpu.tools.flowlint import REPO_ROOT
+
+FIXTURE = pathlib.Path(__file__).resolve().parent / "lint_fixtures" / "coverage-sites"
 
 
-def _site_call_sites() -> list[tuple[str, str, str]]:
-    """Every (kind, name, file:line) with a LITERAL site string.  Kind is
-    'testcov' or 'buggify'; `maybe_delay(loop, site)` is a buggify site
-    (it delegates), with the site string in argument position 1."""
-    out: list[tuple[str, str, str]] = []
-    for path in sorted(PKG.rglob("*.py")):
-        for node in ast.walk(ast.parse(path.read_text())):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = (
-                fn.attr if isinstance(fn, ast.Attribute)
-                else getattr(fn, "id", None)
-            )
-            if name == "maybe_delay":
-                arg = node.args[1] if len(node.args) > 1 else None
-                kind = "buggify"
-            elif name in ("testcov", "buggify"):
-                arg = node.args[0] if node.args else None
-                kind = name
-            else:
-                continue
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                out.append((kind, arg.value, f"{path.name}:{node.lineno}"))
-    return out
+def _hits(which: str) -> list:
+    findings = run_lint([str(FIXTURE / which)], root=REPO_ROOT, spec_dir=None)
+    return [f for f in findings if f.rule == "coverage-sites"]
 
 
-def test_site_strings_unique_per_call_site():
-    """One site name, one call site: a duplicated name would merge two
-    code paths into one census row, so a campaign could report a path as
-    covered when only its twin ever ran."""
-    sites = _site_call_sites()
-    assert len(sites) > 40, "site scan found implausibly few call sites"
-    seen: dict[tuple[str, str], str] = {}
-    dupes = []
-    for kind, name, at in sites:
-        key = (kind, name)
-        if key in seen:
-            dupes.append((kind, name, seen[key], at))
-        else:
-            seen[key] = at
-    assert not dupes, f"duplicate coverage site strings: {dupes}"
+def test_coverage_sites_rule_fires_on_known_bad_fixture():
+    msgs = [f.message for f in _hits("bad")]
+    assert any("duplicate" in m for m in msgs), msgs
+    assert any("mirror" in m for m in msgs), msgs
 
 
-def test_buggify_names_never_shadow_testcov():
-    """buggify fires mirror into testcov under `buggify.<site>`
-    (runtime/buggify.py): no literal testcov name may start with
-    'buggify.' or the mirror would collide with a hand-written site."""
-    for kind, name, at in _site_call_sites():
-        if kind == "testcov":
-            assert not name.startswith("buggify."), (at, name)
+def test_coverage_sites_rule_passes_the_clean_fixture():
+    assert not _hits("ok")
 
 
-def test_required_coverage_manifests_reference_real_sites():
-    """Every tests/specs/*.coverage manifest line must name a real site:
-    `buggify.<site>` resolves against the buggify call sites, bare names
-    against the testcov ones.  A manifest typo would otherwise fail every
-    campaign as 'missing coverage' (or worse, a renamed site would leave
-    a stale requirement that can never be satisfied)."""
-    from foundationdb_tpu.tools.soak import load_manifest
-
-    sites = _site_call_sites()
-    buggify_sites = {n for k, n, _ in sites if k == "buggify"}
-    testcov_sites = {n for k, n, _ in sites if k == "testcov"}
-    manifests = sorted(SPEC_DIR.glob("*.coverage"))
-    assert manifests, "spec corpus carries no required-coverage manifest"
-    for mpath in manifests:
-        for name in load_manifest(str(mpath)):
-            if name.startswith("buggify."):
-                site = name[len("buggify."):]
-                assert site in buggify_sites, (
-                    f"{mpath.name}: {name!r} names no buggify call site"
-                )
-            else:
-                assert name in testcov_sites, (
-                    f"{mpath.name}: {name!r} names no testcov call site"
-                )
-
-
-def test_manifests_pair_with_spec_files():
-    """A manifest without its spec is dead weight; the pairing convention
-    (<stem>.coverage next to <stem>.txt) is what tools/soak.py resolves."""
-    for mpath in SPEC_DIR.glob("*.coverage"):
-        assert (SPEC_DIR / (mpath.stem + ".txt")).exists(), (
-            f"{mpath.name} has no matching spec file"
-        )
+def test_manifest_checks_ride_the_rule(tmp_path):
+    """The manifest half of the old guard (every tests/specs/*.coverage
+    line names a real site; every manifest pairs with its spec) migrated
+    too: point the rule at a spec dir with a typo'd manifest and an
+    orphaned one, and it fires on both."""
+    (tmp_path / "Good.txt").write_text("testTitle=Good\n")
+    (tmp_path / "Good.coverage").write_text("no.such.site\n")
+    (tmp_path / "Orphan.coverage").write_text("# nothing required\n")
+    findings = run_lint([str(FIXTURE / "ok")], root=REPO_ROOT,
+                        spec_dir=str(tmp_path))
+    msgs = [f.message for f in findings if f.rule == "coverage-sites"]
+    assert any("no such call site" in m for m in msgs), msgs
+    assert any("no matching spec file" in m for m in msgs), msgs

@@ -1,0 +1,293 @@
+"""flowlint test surface (docs/LINT.md).
+
+Three layers, mirroring how the reference trusts its actor compiler:
+
+  1. every rule is PROVEN to fire — a `tests/lint_fixtures/<rule>/bad`
+     tree must trip the rule and the sibling `ok` tree must not, so a
+     rule that silently stops matching fails the suite, not the field;
+  2. the baseline ratchet only tightens — grandfathered findings pass,
+     a NEW finding fails, and a STALE baseline entry (the site was
+     fixed) also fails until the entry is deleted;
+  3. the committed tree is clean — the tier-1 gate runs the full pass
+     over foundationdb_tpu/ + tests/ and requires zero unbaselined
+     findings, which is what `python -m foundationdb_tpu.tools.flowlint
+     foundationdb_tpu tests` enforces from the command line.
+
+Plus the PR-9 regression pins for sites the lint audit FIXED (rather
+than suppressed): discover_gateway's retry pacing and the sim clusters'
+deterministic trace-file WallTime.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from foundationdb_tpu.lint import (
+    apply_baseline,
+    default_rules,
+    load_baseline,
+    run_lint,
+)
+from foundationdb_tpu.tools.flowlint import DEFAULT_BASELINE, REPO_ROOT
+from foundationdb_tpu.tools.flowlint import main as flowlint_main
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+RULE_DIRS = sorted(d.name for d in FIXTURES.iterdir() if d.is_dir())
+
+
+def lint_fixture(rule: str, which: str):
+    """Lint one fixture tree.  Root is the repo so the fixture paths keep
+    their `lint_fixtures` marker (package-scope treatment); spec_dir is
+    disabled so manifest checks don't resolve against the REAL spec
+    corpus while only fixture call sites are in view."""
+    return run_lint([str(FIXTURES / rule / which)], root=REPO_ROOT,
+                    spec_dir=None)
+
+
+def test_fixture_dirs_cover_every_rule():
+    """One bad/ok pair per rule — a new rule without fixtures (or a
+    fixture dir for a deleted rule) fails here before it can rot."""
+    ids = {r.id for r in default_rules()} | {"suppression"}
+    assert ids == set(RULE_DIRS)
+    assert len(default_rules()) >= 9  # the acceptance floor
+
+
+@pytest.mark.parametrize("rule", RULE_DIRS)
+def test_rule_fires_on_bad_fixture(rule):
+    hits = [f for f in lint_fixture(rule, "bad") if f.rule == rule]
+    assert hits, f"rule {rule!r} did not fire on its bad fixture"
+    for f in hits:
+        # findings carry the full triage surface: file:line + rule + hint
+        assert f.path.startswith("tests/lint_fixtures/")
+        assert f.line > 0
+        assert f.message
+        rendered = f.render()
+        assert f"[{rule}]" in rendered and f":{f.line}:" in rendered
+
+
+@pytest.mark.parametrize("rule", RULE_DIRS)
+def test_rule_stays_silent_on_ok_fixture(rule):
+    hits = [f for f in lint_fixture(rule, "ok") if f.rule == rule]
+    assert not hits, [f.render() for f in hits]
+
+
+def test_findings_carry_fix_hints():
+    """The one-line fix hint rides every finding (Flow's compiler errors
+    tell you what to do, not just what you did)."""
+    findings = lint_fixture("wall-clock", "bad")
+    assert findings and all(f.hint for f in findings if f.rule == "wall-clock")
+    assert any("bound clock" in f.hint for f in findings)
+
+
+# -- suppression semantics ----------------------------------------------------
+
+
+def test_inline_and_standalone_pragmas_cover_their_line():
+    """ok/pragmas.py mixes an inline reasoned pragma and the fixture set
+    proves a suppressed site yields nothing; bad/pragmas.py's reasonless
+    and unknown-rule pragmas are themselves findings (the escape hatch
+    stays auditable)."""
+    bad = lint_fixture("suppression", "bad")
+    msgs = [f.message for f in bad if f.rule == "suppression"]
+    assert any("without a reason" in m for m in msgs)
+    assert any("unknown rule" in m for m in msgs)
+
+
+# -- baseline ratchet ---------------------------------------------------------
+
+
+def _write_mod(tmp_path: pathlib.Path, body: str) -> pathlib.Path:
+    pkg = tmp_path / "foundationdb_tpu"
+    pkg.mkdir(exist_ok=True)
+    mod = pkg / "mod.py"
+    mod.write_text(body)
+    return mod
+
+
+def test_new_finding_fails_the_run():
+    # the committed default baseline grandfathers nothing for fixtures,
+    # so a bad fixture linted through the CLI surface exits non-zero
+    rc = flowlint_main([str(FIXTURES / "wall-clock" / "bad"),
+                        "--root", REPO_ROOT])
+    assert rc == 1
+
+
+def test_baseline_grandfathers_then_goes_stale(tmp_path):
+    """The full ratchet cycle: violation -> grandfathered (exit 0) ->
+    site fixed -> the now-stale baseline entry FAILS the run until it is
+    deleted (zero-or-fail in both directions)."""
+    mod = _write_mod(tmp_path, "import time\n\n\ndef f():\n    return time.time()\n")
+    bl = tmp_path / "baseline.json"
+    pkg = str(tmp_path / "foundationdb_tpu")
+    args = [pkg, "--root", str(tmp_path), "--baseline", str(bl)]
+
+    assert flowlint_main(args + ["--write-baseline"]) == 0
+    doc = json.loads(bl.read_text())
+    assert doc["findings"], "grandfathering recorded no findings"
+    assert flowlint_main(args) == 0  # baselined: green
+
+    mod.write_text("def f(loop):\n    return loop.now()\n")
+    assert flowlint_main(args) == 1  # stale entry: red
+
+    assert flowlint_main(args + ["--write-baseline"]) == 0  # prune it
+    assert json.loads(bl.read_text())["findings"] == []
+    assert flowlint_main(args) == 0
+
+
+def test_committed_baseline_is_fresh():
+    """Tier-1 gate: the full pass over the real tree yields zero
+    unbaselined findings AND zero stale baseline entries — exactly what
+    `python -m foundationdb_tpu.tools.flowlint foundationdb_tpu tests`
+    enforces."""
+    findings = run_lint([str(pathlib.Path(REPO_ROOT) / "foundationdb_tpu"),
+                         str(pathlib.Path(REPO_ROOT) / "tests")],
+                        root=REPO_ROOT)
+    new, _old, stale = apply_baseline(findings, load_baseline(DEFAULT_BASELINE))
+    assert not new, "unbaselined findings:\n" + "\n".join(f.render() for f in new)
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+# -- CLI surfaces -------------------------------------------------------------
+
+
+def test_list_rules_names_every_rule(capsys):
+    assert flowlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for r in default_rules():
+        assert r.id in out
+
+
+def test_cli_lint_subcommand_is_green_on_the_tree():
+    """`cli lint` (no args) lints foundationdb_tpu + tests against the
+    committed baseline and exits 0 — the CI invocation."""
+    r = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.tools.cli", "lint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new finding(s)" in r.stdout
+
+
+def test_flag_only_invocation_defaults_to_the_tree(capsys):
+    """Review-pass pin: `cli lint --json` forwards flag-only argv; flowlint
+    must default the paths to foundationdb_tpu + tests instead of dying
+    with a usage error because argv was non-empty."""
+    rc = flowlint_main(["--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    doc = json.loads(out)
+    assert doc["new"] == [] and doc["stale_baseline"] == []
+
+
+def test_metrics_schema_rule_fails_loudly_when_emitter_scan_breaks(tmp_path):
+    """Review-pass pin: a populated ROLE_METRICS_SCHEMA with NO
+    spawn_role_metrics/spawn_wire_metrics call found across the other
+    package files is a broken scan anchor (or a fully stale schema) and
+    must be a finding — the silent `return` here is exactly how the
+    deleted AST-guard test would have failed loudly.  The anchor module
+    linted ALONE is a partial tree and must stay silent."""
+    pkg = tmp_path / "foundationdb_tpu"
+    pkg.mkdir()
+    (pkg / "status.py").write_text(
+        "ROLE_METRICS_SCHEMA: dict = {\n    \"GhostMetrics\": {},\n}\n")
+    (pkg / "other.py").write_text("def noop():\n    return 1\n")
+    full = run_lint([str(pkg)], root=str(tmp_path), spec_dir=None)
+    hits = [f for f in full if f.rule == "metrics-schema"]
+    assert hits and "no spawn_role_metrics" in hits[0].message
+    partial = run_lint([str(pkg / "status.py")], root=str(tmp_path),
+                       spec_dir=None)
+    assert not [f for f in partial if f.rule == "metrics-schema"]
+
+
+# -- regression pins for sites the audit FIXED --------------------------------
+
+
+def test_discover_gateway_stays_off_the_wall_clock():
+    """PR-9 fix pin: discover_gateway paced its quorum-retry loop with
+    time.monotonic()/time.sleep() (blocking the process so a late quorum
+    reply could only land AFTER the backoff).  It now routes deadlines
+    and backoff through the bound clock and keeps pumping the network.
+    The wall-clock rule must stay silent on this file — and silent
+    because the site is FIXED, not because a pragma crept in."""
+    path = pathlib.Path(REPO_ROOT) / "foundationdb_tpu" / "client" / "cluster_file.py"
+    findings = run_lint([str(path)], root=REPO_ROOT, spec_dir=None)
+    assert not [f for f in findings if f.rule == "wall-clock"]
+    # silent because fixed, not because suppressed: a pragma would hide a
+    # reintroduced wall clock from the rule but not from this assert
+    assert "ok wall-clock" not in path.read_text()
+
+
+def test_sim_trace_walltime_comes_from_the_bound_clock():
+    """PR-9 fix pin: trace-file lines used to stamp WallTime from the
+    host (time.time), so two runs of one seed produced different bytes.
+    TraceCollector now accepts a wall_clock and the sim clusters bind
+    their virtual clock — identical runs, identical trace files."""
+    from foundationdb_tpu.runtime.trace import TraceCollector
+
+    lines: list[str] = []
+
+    class Sink:
+        def write(self, s: str) -> None:
+            lines.append(s)
+
+    t = TraceCollector(clock=lambda: 7.25, sink=Sink(), wall_clock=lambda: 7.25)
+    t.trace("FixturePinEvent")
+    assert json.loads(lines[0])["WallTime"] == 7.25
+
+    # and SimCluster actually binds it (the sim trace plane is virtual
+    # end to end — the integration the fixture above pins in isolation)
+    from foundationdb_tpu.cluster import SimCluster
+
+    c = SimCluster(seed=11)
+    assert c.trace._wall_clock == c.loop.now
+    c.stop()
+
+
+def test_same_seed_reruns_roll_byte_stable_trace_files(tmp_path):
+    """PR-9 fix pin, end to end: one seed run twice must roll
+    byte-identical trace files.  The single sanctioned exception is
+    SlowTask — its DurationS measures how long a reactor callback
+    stalled in HOST wall time (runtime/core.py), profiling data the
+    virtual clock cannot see and so nondeterministic by definition.
+    Everything else, WallTime stamps included, must match to the byte."""
+    from foundationdb_tpu.runtime.trace import TraceFileSink
+    from foundationdb_tpu.workloads.spec import run_spec
+
+    spec = (
+        "testTitle=TraceByteStability\n"
+        "seed=99\n"
+        "chaos=true\n"
+        "\n"
+        "testName=Cycle\n"
+        "nodes=6\n"
+        "clients=2\n"
+        "txnsPerClient=4\n"
+    )
+
+    def one_run(name: str) -> list[str]:
+        outdir = tmp_path / name
+        outdir.mkdir()
+        sink = TraceFileSink(str(outdir / "trace"))
+        try:
+            run_spec(spec, deadline=600.0, seed=99, trace_sink=sink,
+                     sample_rate=1.0)
+        finally:
+            sink.close()
+        return [
+            line
+            for f in sorted(outdir.glob("trace.*.jsonl"))
+            for line in f.read_text().splitlines()
+        ]
+
+    def sans_slow_tasks(lines: list[str]) -> list[str]:
+        return [l for l in lines if '"Type": "SlowTask"' not in l]
+
+    a, b = one_run("a"), one_run("b")
+    assert sans_slow_tasks(a) == sans_slow_tasks(b)
+    # and not vacuously: the runs actually rolled a real event stream
+    assert len(sans_slow_tasks(a)) > 50

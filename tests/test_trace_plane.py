@@ -1,12 +1,14 @@
 """Distributed commit-path tracing plane (docs/OBSERVABILITY.md
 "Distributed tracing"): flight-recorder ring retention, rate-converted
 counters, severity filtering + rolling trace files, wire-propagated spans,
-the periodic per-role `*Metrics` emission, the trace_tool join, the WARN+
-event-type guard, and the sampling-off overhead contract."""
+the periodic per-role `*Metrics` emission, the trace_tool join, and the
+sampling-off overhead contract.  The WARN+ event-type and metrics-schema
+AST guards that lived here migrated into flowlint (PR 9: `warn-events` /
+`metrics-schema` rules in foundationdb_tpu/lint/rules_registry.py); the
+thin wrappers below prove those rules still fire on their bad fixtures."""
 
 from __future__ import annotations
 
-import ast
 import json
 import os
 import pathlib
@@ -14,10 +16,7 @@ import time
 
 from foundationdb_tpu.cluster import SimCluster
 from foundationdb_tpu.control.recoverable import RecoverableCluster
-from foundationdb_tpu.control.status import (
-    ROLE_METRICS_SCHEMA,
-    validate_metrics_event,
-)
+from foundationdb_tpu.control.status import validate_metrics_event
 from foundationdb_tpu.runtime.knobs import CoreKnobs
 from foundationdb_tpu.runtime.trace import (
     SEV_DEBUG,
@@ -25,7 +24,6 @@ from foundationdb_tpu.runtime.trace import (
     CounterCollection,
     TraceCollector,
     TraceFileSink,
-    WARN_EVENT_TYPES,
     g_trace_batch,
 )
 
@@ -269,29 +267,24 @@ def test_every_role_emits_metrics_within_one_interval():
     c.stop()
 
 
-def test_metrics_events_are_schema_listed():
-    """Every emitted *Metrics type is in ROLE_METRICS_SCHEMA, and the
-    schema has no stale entries for event types nothing emits (kept honest
-    both ways via the emitting call sites)."""
-    emitted = set()
-    pkg = pathlib.Path(__file__).resolve().parent.parent / "foundationdb_tpu"
-    for path in pkg.rglob("*.py"):
-        src = path.read_text()
-        for node in ast.walk(ast.parse(src)):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id in ("spawn_role_metrics", "spawn_wire_metrics")
-            ):
-                for arg in node.args:
-                    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-                            and arg.value.endswith("Metrics"):
-                        emitted.add(arg.value)
-                if node.func.id == "spawn_wire_metrics":
-                    emitted.add("WireMetrics")
-    assert emitted == set(ROLE_METRICS_SCHEMA), (
-        f"emitters {emitted} vs schema {set(ROLE_METRICS_SCHEMA)}"
-    )
+def test_metrics_schema_guard_migrated_to_flowlint():
+    """Every-emitted-*Metrics-type-is-schema-listed (both ways) is now
+    flowlint's `metrics-schema` rule, enforced tree-wide by the tier-1
+    gate (tests/test_flowlint.py).  This wrapper proves the rule still
+    fires: the bad fixture emits a type missing from its schema AND
+    carries a stale schema entry nothing emits."""
+    from foundationdb_tpu.lint import run_lint
+    from foundationdb_tpu.tools.flowlint import REPO_ROOT
+
+    fx = pathlib.Path(__file__).resolve().parent / "lint_fixtures" / "metrics-schema"
+    msgs = [f.message
+            for f in run_lint([str(fx / "bad")], root=REPO_ROOT, spec_dir=None)
+            if f.rule == "metrics-schema"]
+    assert any("not in" in m for m in msgs), msgs
+    assert any("emitted nowhere" in m for m in msgs), msgs
+    assert not [f for f in run_lint([str(fx / "ok")], root=REPO_ROOT,
+                                    spec_dir=None)
+                if f.rule == "metrics-schema"]
 
 
 # -- trace_tool: the cross-process join --------------------------------------
@@ -373,55 +366,29 @@ def test_timeline_is_a_thin_consumer_of_the_join():
     g_trace_batch.attach_clock(lambda: 0.0)
 
 
-# -- guard: WARN+ event types unique and schema-listed -----------------------
+# -- guard: WARN+ event types unique and schema-listed (migrated) ------------
 
 
-def _warn_trace_call_sites():
-    """Every `trace(...)` / `_trace_wire_error(...)` call site in the
-    package with a literal event-type name, flagged WARN+ when the call
-    names SEV_WARN/SEV_WARN_ALWAYS/SEV_ERROR (conditional severities count:
-    the event CAN warn) — _trace_wire_error hardwires SEV_WARN."""
-    pkg = pathlib.Path(__file__).resolve().parent.parent / "foundationdb_tpu"
-    sites = []
-    for path in sorted(pkg.rglob("*.py")):
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
-            if name not in ("trace", "_trace_wire_error"):
-                continue
-            if not node.args or not isinstance(node.args[0], ast.Constant) \
-                    or not isinstance(node.args[0].value, str):
-                continue
-            warn = name == "_trace_wire_error"
-            for kw in node.keywords:
-                if kw.arg == "severity":
-                    warn = warn or bool({
-                        n.id for n in ast.walk(kw.value)
-                        if isinstance(n, ast.Name)
-                    } & {"SEV_WARN", "SEV_WARN_ALWAYS", "SEV_ERROR"})
-            sites.append((node.args[0].value, warn, f"{path.name}:{node.lineno}"))
-    return sites
+def test_warn_event_guard_migrated_to_flowlint():
+    """The WARN+ event-type discipline (registered in WARN_EVENT_TYPES,
+    ONE call site per type, no stale registry names) is now flowlint's
+    `warn-events` rule, enforced tree-wide by the tier-1 gate
+    (tests/test_flowlint.py).  This wrapper proves the rule still fires:
+    the bad fixture has an unregistered WARN+ event, a duplicated call
+    site, and a stale registry entry."""
+    from foundationdb_tpu.lint import run_lint
+    from foundationdb_tpu.tools.flowlint import REPO_ROOT
 
-
-def test_warn_event_types_unique_and_schema_listed():
-    """The status-schema discipline for warning traces: every SEV_WARN+
-    event type is registered in WARN_EVENT_TYPES, each has exactly ONE
-    call site (no silent shadowing in track_latest / cluster.messages),
-    and the registry carries no stale names."""
-    warn_sites = [(n, at) for n, w, at in _warn_trace_call_sites() if w]
-    names = [n for n, _at in warn_sites]
-    dupes = {n for n in names if names.count(n) > 1}
-    assert not dupes, f"WARN+ event types with multiple call sites: {dupes}"
-    unregistered = set(names) - WARN_EVENT_TYPES
-    assert not unregistered, (
-        f"WARN+ trace events not in runtime/trace.py WARN_EVENT_TYPES: "
-        f"{[(n, at) for n, at in warn_sites if n in unregistered]}"
-    )
-    stale = WARN_EVENT_TYPES - set(names)
-    assert not stale, f"WARN_EVENT_TYPES entries with no call site: {stale}"
+    fx = pathlib.Path(__file__).resolve().parent / "lint_fixtures" / "warn-events"
+    msgs = [f.message
+            for f in run_lint([str(fx / "bad")], root=REPO_ROOT, spec_dir=None)
+            if f.rule == "warn-events"]
+    assert any("not in WARN_EVENT_TYPES" in m for m in msgs), msgs
+    assert any("multiple call sites" in m for m in msgs), msgs
+    assert any("no call site" in m for m in msgs), msgs
+    assert not [f for f in run_lint([str(fx / "ok")], root=REPO_ROOT,
+                                    spec_dir=None)
+                if f.rule == "warn-events"]
 
 
 # -- sampling-off overhead smoke ---------------------------------------------
