@@ -744,28 +744,28 @@ class RecoverableCluster:
             cluster2 = RecoverableCluster(seed=..., fs=fs, restart=True)
         """
         assert self.fs is not None, "power_off needs a durable cluster"
-        self._wire_metrics_task.cancel()
-        self.loop.slow_task_trace = None
-        if getattr(self, "_monitor_task", None) is not None:
-            self._monitor_task.cancel()
-        for w in self.workers:
-            w.stop()
-        if self.log_router is not None:
-            self.log_router.stop()
-        for s in self.remote_storage:
-            s.stop()
-        self.dd.stop()
-        self.ratekeeper.stop()
-        self.controller.stop()
-        for c in self.coordinators:
-            c.stop()
-        for s in self.storage:
-            s.stop()
+        self.stop()
         for proc in list(self.net.processes.values()):
             proc.kill()
         return self.fs
 
+    def clean_shutdown(self):
+        """The orderly opposite of power_off: every buffered write is
+        flushed durable (fs.flush_buffers) BEFORE the processes die, as an
+        operator-driven halt would.  Exists for the negative
+        crash-durability tests: a restarting pair whose kill were secretly
+        this clean path would wrongly preserve un-fsynced data, which is
+        exactly what those tests assert cannot happen."""
+        assert self.fs is not None, "clean_shutdown needs a durable cluster"
+        self.fs.flush_buffers()
+        return self.power_off()
+
     def stop(self) -> None:
+        # idempotent: a power-killed cluster (SaveAndKill) is stop()ped
+        # again by run_spec's teardown; the second call must be a no-op
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self._wire_metrics_task.cancel()
         for t in self._client_metric_tasks:
             t.cancel()

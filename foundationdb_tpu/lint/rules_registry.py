@@ -183,6 +183,23 @@ class CoverageSiteRule(Rule):
     hint = ("one site name, one call site — a duplicated name merges two "
             "code paths into one census row; rename the newer site")
 
+    @staticmethod
+    def _is_pair_stem(stem: str) -> bool:
+        """workloads/spec.py is_restarting_pair, re-stated as a text scan
+        so the linter never imports the runtime: both halves on disk and
+        a SaveAndKill stanza in the -1 half."""
+        if not (os.path.exists(stem + "-1.txt")
+                and os.path.exists(stem + "-2.txt")):
+            return False
+        try:
+            with open(stem + "-1.txt", encoding="utf-8") as f:
+                return any(
+                    line.split(";")[0].strip().replace(" ", "")
+                    == "testName=SaveAndKill"
+                    for line in f)
+        except OSError:
+            return False
+
     def check_project(self, ctx: LintContext) -> Iterable[Finding]:
         sites = _site_call_sites(ctx)
         seen: dict[tuple[str, str], str] = {}
@@ -206,13 +223,26 @@ class CoverageSiteRule(Rule):
             return
         buggify_sites = {n for k, n, _sf, _ln in sites if k == "buggify"}
         testcov_sites = {n for k, n, _sf, _ln in sites if k == "testcov"}
-        for mpath in sorted(glob.glob(os.path.join(ctx.spec_dir, "*.coverage"))):
+        for mpath in sorted(glob.glob(
+                os.path.join(ctx.spec_dir, "**", "*.coverage"),
+                recursive=True)):
             rel = os.path.relpath(mpath, ctx.root).replace(os.sep, "/")
-            if not os.path.exists(mpath[: -len(".coverage")] + ".txt"):
+            stem = mpath[: -len(".coverage")]
+            # a restarting pair (`<stem>-1.txt`/`<stem>-2.txt`) shares one
+            # manifest at `<stem>.coverage` — tools/soak.py merges both
+            # halves' census.  Mirror soak's predicate without importing
+            # the runtime (this is a static tool): BOTH halves must exist
+            # and the -1 half must actually carry a SaveAndKill stanza,
+            # or the stem manifest is orphaned at runtime (soak maps
+            # non-pairs to their own `<name>.coverage` files)
+            if not os.path.exists(stem + ".txt") \
+                    and not self._is_pair_stem(stem):
                 yield Finding(
                     self.id, rel, 1,
                     f"{os.path.basename(mpath)} has no matching spec file",
-                    "the convention is `<stem>.coverage` next to `<stem>.txt`")
+                    "the convention is `<stem>.coverage` next to "
+                    "`<stem>.txt` (or the full `<stem>-1.txt`/`-2.txt` "
+                    "restarting pair)")
             with open(mpath, encoding="utf-8") as f:
                 for i, line in enumerate(f, start=1):
                     name = line.strip()

@@ -43,6 +43,10 @@ ENV_KNOBS: dict[str, str] = {
                               "(tools/soak.py)",
     "FDBTPU_SOAK_DEVICE": "=1 lets a soak campaign's seed subprocesses use "
                           "the device backend (tools/soak.py)",
+    "FDBTPU_RESTART_DIR": "restart-image directory override when the caller "
+                          "passes none: SaveAndKill part-1 saves land there "
+                          "and run_restarting_pair uses it instead of a temp "
+                          "dir (workloads/spec.py)",
 }
 
 

@@ -30,6 +30,19 @@ class _FileState:
         # durable data before its replacement is durable.
         self.pending_truncate = False
 
+    def apply_buffers(self) -> None:
+        """The ONE encoding of what fsync makes durable: a pending
+        journaled truncate lands first, then the buffered tail.  Shared by
+        SimFile.sync (per-file fsync) and SimFilesystem.flush_buffers (the
+        orderly-shutdown flush) so the two can never drift — the negative
+        crash-durability tests discriminate between exactly these paths."""
+        if self.pending_truncate:
+            self.synced = bytearray()
+            self.pending_truncate = False
+        for chunk in self.unsynced:
+            self.synced.extend(chunk)
+        self.unsynced.clear()
+
 
 class SimFile:
     """An open handle: append/sync/read of one simulated file."""
@@ -65,13 +78,7 @@ class SimFile:
             # its disk never saw, the phantom the recovery-version rule
             # exists to exclude).  The dead process's code must see failure.
             raise IOError(f"{self.path}: process died during fsync")
-        if self._st.pending_truncate:
-            self._st.synced = bytearray()
-            self._st.pending_truncate = False
-        if self._st.unsynced:
-            for chunk in self._st.unsynced:
-                self._st.synced.extend(chunk)
-            self._st.unsynced.clear()
+        self._st.apply_buffers()
 
     def truncate(self) -> None:
         """Journaled truncate: buffered contents are dropped now, but the
@@ -179,6 +186,39 @@ class SimFilesystem:
                 process.on_death.append(p)
             handles.add(f)
         return f
+
+    def durable_items(self):
+        """(path, crash-surviving bytes) for every file — the synced prefix
+        only (`SimFile.read_durable` semantics): what a restart image saves
+        after a power-kill has dropped the un-fsynced buffers."""
+        for path in sorted(self._files):
+            yield path, bytes(self._files[path].synced)
+
+    @classmethod
+    def from_durable_items(cls, items) -> "SimFilesystem":
+        """The restore twin of `durable_items`: a fresh filesystem whose
+        disks hold exactly `items` ({path: bytes} or (path, bytes) pairs)
+        as durable contents — synced prefixes only, nothing buffered.
+        Built on a throwaway loop/rng; RecoverableCluster(fs=...,
+        restart=True) reattaches it to the booting cluster's."""
+        from ..runtime.core import DeterministicRandom, EventLoop
+
+        pairs = items.items() if hasattr(items, "items") else items
+        fs = cls(EventLoop(), DeterministicRandom(0))
+        for path, data in pairs:
+            st = _FileState()
+            st.synced = bytearray(data)
+            fs._files[path] = st
+        return fs
+
+    def flush_buffers(self) -> None:
+        """Apply every file's buffered state to its durable contents — the
+        ORDERLY-shutdown flush (sync-everything-then-halt), the exact
+        opposite of a power-kill.  Exists so the negative crash-durability
+        test can prove the kill path is unclean: data that survives a
+        clean shutdown must NOT survive the kill."""
+        for st in self._files.values():
+            st.apply_buffers()
 
     def exists(self, path: str) -> bool:
         return path in self._files

@@ -340,14 +340,52 @@ class Cli:
                         stdout.write(f"ERROR: {e!r}\n")
 
 
+def spec_main(argv: list[str]) -> int:
+    """`cli spec PATH [--seed N] [--deadline S] [--image-dir DIR]`: run one
+    spec file — or a restarting pair, auto-discovered when PATH is either
+    half (`Name-1.txt`/`Name-2.txt`) or the bare stem — and print the
+    metrics JSON.  The single-spec flavor of `cli soak` (tester.actor.cpp
+    running one tests/*.txt file)."""
+    import argparse
+
+    from ..workloads import spec as _spec
+
+    ap = argparse.ArgumentParser(prog="spec", description=spec_main.__doc__)
+    ap.add_argument("path", help="spec file, pair half, or pair stem")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the spec's cluster seed (both pair halves)")
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="virtual-clock deadline inside the run")
+    ap.add_argument("--image-dir", default=None,
+                    help="restart-image directory for a pair (default: a "
+                         "temp dir; FDBTPU_RESTART_DIR overrides saves when "
+                         "running a part-1 spec directly)")
+    args = ap.parse_args(argv)
+    if _spec.should_run_pair(args.path):
+        metrics = _spec.run_restarting_pair(
+            args.path, deadline=args.deadline, seed=args.seed,
+            image_dir=args.image_dir,
+        )
+    else:
+        metrics = _spec.run_spec_file(
+            args.path, deadline=args.deadline, seed=args.seed,
+            save_dir=args.image_dir,
+        )
+    print(json.dumps(metrics, indent=2, default=str))
+    return 0
+
+
 def main() -> None:
     # batch subcommands ride the same entry point as the REPL (fdbcli's
     # --exec flavor): `cli soak SPEC ...` runs a soak campaign and exits;
-    # `cli lint [paths...]` runs the flowlint static pass (docs/LINT.md)
+    # `cli spec PATH` runs one spec file or restarting pair; `cli lint
+    # [paths...]` runs the flowlint static pass (docs/LINT.md)
     if len(sys.argv) > 1 and sys.argv[1] == "soak":
         from .soak import main as soak_main
 
         sys.exit(soak_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "spec":
+        sys.exit(spec_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "lint":
         from .flowlint import main as lint_main
 

@@ -135,8 +135,17 @@ def load_manifest(path: str) -> list[str]:
 
 
 def manifest_for_spec(spec_path: str) -> str | None:
-    """The convention: `<spec stem>.coverage` next to the spec file."""
+    """The convention: `<spec stem>.coverage` next to the spec file.  A
+    restarting pair (`<stem>-1.txt`/`<stem>-2.txt`, or the bare stem)
+    shares ONE manifest at `<stem>.coverage` — the campaign census merges
+    both halves' trace events, so required sites may live in either."""
+    from ..workloads import spec as _spec
+
     base, _ = os.path.splitext(spec_path)
+    if base.endswith(("-1", "-2")) and _spec.is_restarting_pair(spec_path):
+        # only an ACTUAL pair shares the stem manifest — a standalone spec
+        # whose name merely ends in -1/-2 keeps its own `<name>.coverage`
+        base = _spec.pair_stem(spec_path)
     path = base + ".coverage"
     return path if os.path.exists(path) else None
 
@@ -162,10 +171,21 @@ def run_one_seed(spec_path: str, seed: int, artifacts: str,
                               "error": None, "wall_s": 0.0}
     t0 = time.time()
     try:
-        metrics = _spec.run_spec_file(
-            spec_path, deadline=sim_deadline, seed=seed,
-            trace_sink=sink, sample_rate=sample_rate,
-        )
+        if _spec.should_run_pair(spec_path):
+            # a restarting pair is ONE seeded unit: part 1 and part 2 run
+            # in this same worker, the image lands in this seed's artifact
+            # dir, and both lifetimes share the trace sink so triage joins
+            # their timelines (docs/OPERATIONS.md restarting-pair runbook)
+            metrics = _spec.run_restarting_pair(
+                spec_path, deadline=sim_deadline, seed=seed,
+                trace_sink=sink, sample_rate=sample_rate,
+                image_dir=os.path.join(artifacts, "image"),
+            )
+        else:
+            metrics = _spec.run_spec_file(
+                spec_path, deadline=sim_deadline, seed=seed,
+                trace_sink=sink, sample_rate=sample_rate,
+            )
         result["metrics"] = metrics
         # the triage-demo hook: fail one named seed AFTER its run so the
         # failing seed still carries a full trace/census to triage

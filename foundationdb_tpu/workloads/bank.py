@@ -64,3 +64,10 @@ class BankWorkload(Workload):
 
     def metrics(self) -> dict:
         return {"committed": self.committed}
+
+    def restart_state(self) -> dict:
+        # money conservation is relative to these: a part 2 declaring a
+        # different account count or initial balance would assert the
+        # wrong total against the saved disks
+        return {"accounts": self.accounts, "initial": self.initial,
+                "expected_total": self.accounts * self.initial}

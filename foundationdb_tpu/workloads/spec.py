@@ -24,7 +24,9 @@ Format (one file = one simulation):
     interval=2.0
 
 `testName` opens a workload stanza; parameters until the next `testName`
-are constructor kwargs (camelCase -> snake_case).  Everything before the
+are constructor kwargs (camelCase -> snake_case), except `runSetup=false`
+which skips the workload's setup phase (the restarting-pair part-2
+convention: the data under test rode the reboot).  Everything before the
 first `testName` configures the cluster — including `backend=supervised`
 (the DeviceSupervisor-wrapped TPU/XLA conflict backend) and
 `sampleRate=R` (transaction-timeline sampling into the trace files).
@@ -32,10 +34,24 @@ first `testName` configures the cluster — including `backend=supervised`
 returns the metrics dict; its seed/trace_sink/sample_rate keywords are
 the per-seed artifact hooks the soak harness (tools/soak.py) drives, and
 teardown emits the run's buggify/testcov census as `CodeCoverage` trace
-events."""
+events.
+
+Restarting pairs (tests/restarting/CycleTestRestart-{1,2}.txt in the
+reference): `<stem>-1.txt` composes a `SaveAndKill` stanza that
+power-kills the whole sim and saves its disk image + manifest;
+`<stem>-2.txt` boots a second process-lifetime from that image
+(`run_spec(..., restart_image=dir)`) and re-runs the invariant checks.
+`run_restarting_pair` drives both halves as one seeded unit and
+`resolve_pair` finds the pair from either half or the bare stem.  Part 2
+REFUSES to boot when its declared seed or disk-shaping cluster config
+mismatches part 1's manifest, or when a same-named workload declares
+different invariant state (`Workload.restart_state`)."""
 
 from __future__ import annotations
 
+import inspect
+import json
+import os
 import re
 
 from .attrition import AttritionWorkload
@@ -49,6 +65,8 @@ from .device_fault import DeviceFaultWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
 from .readwrite import ReadWriteWorkload
+from .rollback import RollbackWorkload
+from .save_and_kill import RestartKill, SaveAndKillWorkload, invariant_states
 from .selector_oracle import SelectorOracleWorkload
 from .serializability import SerializabilityWorkload
 from .swizzle import SwizzleWorkload
@@ -70,6 +88,8 @@ WORKLOAD_FACTORY = {
     "WriteDuringRead": WriteDuringReadWorkload,
     "DeviceFault": DeviceFaultWorkload,
     "SelectorOracle": SelectorOracleWorkload,
+    "SaveAndKill": SaveAndKillWorkload,
+    "Rollback": RollbackWorkload,
 }
 
 # spec key -> RecoverableCluster kwarg
@@ -94,6 +114,15 @@ _CLUSTER_KEYS = {
     # buggify sites to mean anything); resolved in run_spec
     "backend": ("backend", str),
 }
+
+# cluster kwargs that SHAPE THE DISK IMAGE (file names, shard layout,
+# replica placement, recovery seeding): part 2 of a restarting pair must
+# match part 1's manifest on these or refuse to boot — booting different
+# values against the saved disks checks the wrong cluster's invariants
+_IMAGE_KEYS = (
+    "seed", "n_storage_shards", "storage_replication", "n_tlogs",
+    "n_machines", "n_dcs", "storage_engine", "redundancy",
+)
 
 # spec `backend=` values -> conflict-backend factories
 _BACKENDS = {
@@ -179,8 +208,77 @@ def parse_spec(text: str) -> tuple[str, dict, list[tuple[str, dict]]]:
     return title, cluster_kwargs, stanzas
 
 
+def _cluster_default(kwarg: str):
+    """RecoverableCluster's own signature default for `kwarg` — the value
+    a spec that omits the key effectively ran with (mismatch checks must
+    compare EFFECTIVE config, not declared-key sets)."""
+    from ..control.recoverable import RecoverableCluster
+
+    return inspect.signature(RecoverableCluster.__init__).parameters[kwarg].default
+
+
+def _check_part2_config(cluster_kwargs: dict, manifest: dict) -> dict:
+    """Validate part 2's declared config against part 1's manifest and
+    return the merged cluster kwargs part 2 boots with: image-shaping
+    keys come from the manifest (declared part-2 values must MATCH),
+    everything else is part 1's value unless part 2 overrides it."""
+    part1 = dict(manifest.get("cluster", {}))
+    part1.pop("backend", None)
+    for key in _IMAGE_KEYS:
+        if key not in cluster_kwargs:
+            continue
+        effective1 = part1.get(key, _cluster_default(key))
+        if cluster_kwargs[key] != effective1:
+            raise ValueError(
+                f"restarting-pair mismatch: part 2 declares {key}="
+                f"{cluster_kwargs[key]!r} but part 1 ran with "
+                f"{effective1!r} (the saved disks belong to part 1's "
+                f"config; fix the -2 spec or re-save the image)"
+            )
+    merged = dict(part1)
+    merged.update(
+        {k: v for k, v in cluster_kwargs.items() if k not in _IMAGE_KEYS}
+    )
+    return merged
+
+
+def _check_restart_states(workloads, saved_states: dict) -> None:
+    """Part 2's same-named-workload drift check.  Saved shape: name ->
+    ORDERED list of states, one per part-1 stanza (save_and_kill.py
+    invariant_states); compare positionally among same-named stanzas so
+    duplicates don't collapse.  Every saved stanza must be covered — a
+    part-2 spec that DROPS a workload whose data rode the reboot would
+    pass while checking nothing.  Extra part-2 stanzas (new checks) are
+    fine.  Live states go through the same JSON round-trip the manifest
+    did, so JSON-equivalent values (tuples vs lists) never refuse a
+    matching pair."""
+
+    def canon(state):
+        return json.loads(json.dumps(state, default=str))
+
+    declared = {name: [canon(s) for s in states]
+                for name, states in invariant_states(workloads).items()}
+    for name, saved in sorted(saved_states.items()):
+        got = declared.get(name, [])
+        if len(got) < len(saved):
+            raise ValueError(
+                f"restarting-pair mismatch: part 1 saved invariant state "
+                f"for {len(saved)} {name} stanza(s) but part 2 declares "
+                f"{len(got)} — every ring/ledger that rode the reboot "
+                f"must be re-checked"
+            )
+        for i, s in enumerate(saved):
+            if got[i] != s:
+                raise ValueError(
+                    f"restarting-pair mismatch: {name} declares invariant "
+                    f"state {got[i]} but part 1 saved {s}"
+                )
+
+
 def run_spec(text: str, deadline: float = 900.0, *, seed: int | None = None,
-             trace_sink=None, sample_rate: float | None = None) -> dict:
+             trace_sink=None, sample_rate: float | None = None,
+             save_dir: str | None = None,
+             restart_image: str | None = None) -> dict:
     """Parse, build the cluster, compose the workloads, run, check.
 
     The keyword hooks are the per-seed artifact surface soak campaigns
@@ -191,27 +289,105 @@ def run_spec(text: str, deadline: float = 900.0, *, seed: int | None = None,
     transaction timelines.  At teardown — pass OR fail — the run's
     buggify/testcov census is emitted into the trace stream as
     `CodeCoverage` events (runtime/{buggify,coverage}.py), which is how
-    coverage crosses the process boundary to the campaign driver."""
+    coverage crosses the process boundary to the campaign driver.
+
+    Restarting-pair hooks: `save_dir` is where a SaveAndKill stanza lands
+    its disk image + manifest (part 1 returns phase-1 metrics with
+    `restart_image` set instead of running checks); `restart_image` boots
+    THIS run from a saved image (part 2) after refusing seed/config/
+    invariant-state mismatches against its manifest."""
     from ..control.recoverable import RecoverableCluster
     from ..runtime import buggify, coverage
+    from ..runtime.coverage import testcov
+    from ..storage.image import load_image, restore_filesystem
 
     title, cluster_kwargs, stanzas = parse_spec(text)
+    backend_declared = "backend" in cluster_kwargs
     backend = cluster_kwargs.pop("backend", "oracle")
     if backend not in _BACKENDS:
         raise ValueError(
             f"unknown backend {backend!r} (known: {sorted(_BACKENDS)})"
         )
-    if _BACKENDS[backend] is not None:
-        cluster_kwargs["conflict_backend"] = _BACKENDS[backend]
     if seed is not None:
         cluster_kwargs["seed"] = seed
     if sample_rate is not None:
         cluster_kwargs["debug_sample_rate"] = sample_rate
+
+    # the census baseline must predate load_image: part 2's
+    # restart.image_loaded hit belongs to THIS run's coverage delta
     cov_base = coverage.snapshot()
-    c = RecoverableCluster(trace_sink=trace_sink, **cluster_kwargs)
+    restart_manifest = None
+    restored_fs = None
+    if restart_image is not None:
+        files, restart_manifest = load_image(restart_image)
+        if not backend_declared:
+            backend = restart_manifest.get("cluster", {}).get("backend", "oracle")
+            if backend not in _BACKENDS:
+                # a version-skewed manifest must fail with the same
+                # diagnostic a bad spec gets, not a KeyError later
+                raise ValueError(
+                    f"unknown backend {backend!r} in restart manifest "
+                    f"(known: {sorted(_BACKENDS)})"
+                )
+        cluster_kwargs = _check_part2_config(cluster_kwargs, restart_manifest)
+        restored_fs = restore_filesystem(files)
+
+    # what the restart manifest records (serializable names, not factories)
+    manifest_cluster = dict(cluster_kwargs, backend=backend)
+
+    c_kwargs = dict(cluster_kwargs)
+    if _BACKENDS[backend] is not None:
+        c_kwargs["conflict_backend"] = _BACKENDS[backend]
+    if restored_fs is not None:
+        c_kwargs["fs"] = restored_fs
+        c_kwargs["restart"] = True
+    c = RecoverableCluster(trace_sink=trace_sink, **c_kwargs)
     try:
-        workloads = [WORKLOAD_FACTORY[name](**kw) for name, kw in stanzas]
-        metrics = run_workloads(c, workloads, deadline=deadline)
+        workloads = []
+        for name, kw in stanzas:
+            kw = dict(kw)
+            run_setup = kw.pop("run_setup", True)
+            if not isinstance(run_setup, bool):
+                # a typo'd runSetup=no would bool() truthy and re-fill the
+                # ring part 2 exists to check — refuse, don't guess
+                raise ValueError(
+                    f"{name}: runSetup expects true/false, "
+                    f"got {run_setup!r}"
+                )
+            w = WORKLOAD_FACTORY[name](**kw)
+            w.run_setup = run_setup
+            workloads.append(w)
+        if restart_manifest is not None:
+            _check_restart_states(workloads,
+                                  restart_manifest.get("workloads", {}))
+            testcov("restart.booted_from_image")
+            c.trace.trace("RestartFromImage", Image=restart_image,
+                          Seed=cluster_kwargs.get("seed", 0),
+                          KilledAt=restart_manifest.get("killed_at"))
+        for w in workloads:
+            if isinstance(w, SaveAndKillWorkload):
+                if save_dir is None:
+                    save_dir = _default_image_dir()
+                w.bind(
+                    save_dir=save_dir,
+                    manifest={
+                        "title": title,
+                        "seed": cluster_kwargs.get("seed", 0),
+                        "cluster": manifest_cluster,
+                        "stanzas": [[n, kw] for n, kw in stanzas],
+                    },
+                    co_workloads=workloads,
+                )
+        try:
+            metrics = run_workloads(c, workloads, deadline=deadline)
+        except RestartKill as rk:
+            # part 1 of a restarting pair: the sim power-killed itself on
+            # purpose; checks belong to part 2's process lifetime
+            metrics = {
+                w.description: w.metrics() for w in workloads
+            }
+            metrics["phase"] = 1
+            metrics["restart_image"] = rk.image_dir
         metrics["testTitle"] = title
         metrics["seed"] = cluster_kwargs.get("seed", 0)
         return metrics
@@ -227,7 +403,159 @@ def run_spec(text: str, deadline: float = 900.0, *, seed: int | None = None,
 
 def run_spec_file(path: str, deadline: float = 900.0, *,
                   seed: int | None = None, trace_sink=None,
-                  sample_rate: float | None = None) -> dict:
+                  sample_rate: float | None = None,
+                  save_dir: str | None = None,
+                  restart_image: str | None = None) -> dict:
+    """Run one spec file — or a whole restarting pair, auto-discovered
+    when `path` is a bare pair stem or either half (`Name-1.txt` /
+    `Name-2.txt`) and the caller passed no save_dir/restart_image (those
+    kwargs mean a driver like run_restarting_pair is running the halves
+    itself)."""
+    if save_dir is None and restart_image is None and should_run_pair(path):
+        return run_restarting_pair(
+            path, deadline=deadline, seed=seed, trace_sink=trace_sink,
+            sample_rate=sample_rate,
+        )
     with open(path) as f:
         return run_spec(f.read(), deadline=deadline, seed=seed,
-                        trace_sink=trace_sink, sample_rate=sample_rate)
+                        trace_sink=trace_sink, sample_rate=sample_rate,
+                        save_dir=save_dir, restart_image=restart_image)
+
+
+# ---------------------------------------------------------------------------
+# restarting pairs
+
+
+def _default_image_dir() -> str:
+    """Where a restart image lands when the caller named no directory:
+    FDBTPU_RESTART_DIR, else a fresh temp dir — never a CWD-relative path
+    derived from the spec title (titles are arbitrary text)."""
+    d = os.environ.get("FDBTPU_RESTART_DIR")
+    if d is None:
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="fdbtpu-restart-")
+    return d
+
+
+def pair_stem(path: str) -> str:
+    """The ONE encoding of the pairing convention: strip `.txt` and a
+    trailing `-1`/`-2` to get the stem shared by both halves (and by the
+    pair's `<stem>.coverage` manifest)."""
+    base = path[:-4] if path.endswith(".txt") else path
+    if base.endswith(("-1", "-2")):
+        base = base[:-2]
+    return base
+
+
+def should_run_pair(path: str) -> bool:
+    """Whether a runner given `path` should substitute the whole pair:
+    only when the path does not name an existing standalone spec, or is
+    itself a pair half — an explicitly named, existing spec always runs
+    as itself even if a same-stem pair coexists."""
+    return (not os.path.exists(path)
+            or path.endswith(("-1.txt", "-2.txt"))) and is_restarting_pair(path)
+
+
+def resolve_pair(path: str) -> tuple[str, str]:
+    """Find a restarting pair from either half or the bare stem:
+    `Name-1.txt`, `Name-2.txt`, `Name.txt`, or `Name` all resolve to
+    (`Name-1.txt`, `Name-2.txt`).  Raises FileNotFoundError when either
+    half is missing — half a restarting test is not a test."""
+    base = pair_stem(path)
+    p1, p2 = base + "-1.txt", base + "-2.txt"
+    missing = [p for p in (p1, p2) if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"restarting pair incomplete for {path!r}: missing "
+            f"{', '.join(missing)}"
+        )
+    return p1, p2
+
+
+def is_restarting_pair(path: str) -> bool:
+    """A restarting pair is two same-stem halves whose -1 half actually
+    contains a SaveAndKill stanza — filename shape alone is not enough,
+    or two unrelated standalone specs that happen to be named Foo-1.txt
+    and Foo-2.txt would be hijacked into a bogus pair run (and their own
+    coverage manifests silently dropped)."""
+    try:
+        p1, _p2 = resolve_pair(path)
+    except FileNotFoundError:
+        return False
+    try:
+        with open(p1) as f:
+            _title, _ck, stanzas = parse_spec(f.read())
+    except (OSError, ValueError, KeyError):
+        return False  # a half that does not parse is not half a pair
+    return any(name == "SaveAndKill" for name, _kw in stanzas)
+
+
+def run_restarting_pair(path: str, deadline: float = 900.0, *,
+                        seed: int | None = None, trace_sink=None,
+                        sample_rate: float | None = None,
+                        image_dir: str | None = None) -> dict:
+    """Both halves of a restarting pair as ONE seeded unit (how the soak
+    harness runs them: same worker, shared artifact dir, one trace sink so
+    triage joins part-1/part-2 timelines).  Part 1 runs to its SaveAndKill
+    power-kill and saves the image under `image_dir`; part 2 boots from it
+    and runs the invariant checks.  `seed` overrides BOTH halves (so the
+    manifest seed check still passes) — the campaign seed matrix never
+    forks the pair."""
+    p1, p2 = resolve_pair(path)
+    # a temp dir WE made is ours to delete once part 2 consumed it; a
+    # directory the caller (or FDBTPU_RESTART_DIR) named is theirs, and a
+    # FAILED pair keeps its image either way — it is the triage artifact
+    ephemeral = image_dir is None and "FDBTPU_RESTART_DIR" not in os.environ
+    if image_dir is None:
+        image_dir = _default_image_dir()
+
+    def discard_ephemeral() -> None:
+        if ephemeral:
+            import shutil
+
+            shutil.rmtree(image_dir, ignore_errors=True)
+
+    try:
+        m1 = run_spec_file(p1, deadline=deadline, seed=seed,
+                           trace_sink=trace_sink, sample_rate=sample_rate,
+                           save_dir=image_dir)
+    except BaseException:
+        from ..storage.image import MANIFEST
+
+        if not os.path.exists(os.path.join(image_dir, MANIFEST)):
+            # part 1 died before SaveAndKill completed a save: the temp
+            # dir holds no image, so there is nothing to keep for triage
+            discard_ephemeral()
+        raise
+    if "restart_image" not in m1:
+        discard_ephemeral()  # nothing saved
+        raise ValueError(
+            f"{p1} ran to completion without a SaveAndKill power-kill — "
+            f"not a part-1 restarting spec"
+        )
+    image = m1["restart_image"]
+    m2 = run_spec_file(p2, deadline=deadline, seed=seed,
+                       trace_sink=trace_sink, sample_rate=sample_rate,
+                       restart_image=image)
+    if "restart_image" in m2:
+        # part 2 power-killed ITSELF (a SaveAndKill stanza copied into
+        # the -2 spec): every check was skipped, so this is not a green
+        # pair — it is a part-2 spec that never checked anything
+        raise ValueError(
+            f"{p2} ended in a SaveAndKill power-kill of its own — part 2 "
+            f"of a restarting pair must run checks, not kill again"
+        )
+    # a FAILED pair (either half raising after the save) keeps its image
+    # for triage; a passing one has no consumer left, so delete a temp
+    # dir and report no path rather than one that no longer exists
+    discard_ephemeral()
+    if ephemeral:
+        image = None
+    return {
+        "testTitle": m2.get("testTitle", m1.get("testTitle")),
+        "seed": m1.get("seed", 0),
+        "restart_image": image,
+        "part1": m1,
+        "part2": m2,
+    }

@@ -44,8 +44,20 @@ def test_manifest_checks_ride_the_rule(tmp_path):
     (tmp_path / "Good.txt").write_text("testTitle=Good\n")
     (tmp_path / "Good.coverage").write_text("no.such.site\n")
     (tmp_path / "Orphan.coverage").write_text("# nothing required\n")
+    # a HALF-deleted restarting pair orphans its stem manifest too: soak
+    # only maps <stem>.coverage for a complete -1/-2 pair
+    (tmp_path / "Half-1.txt").write_text("testTitle=Half\n")
+    (tmp_path / "Half.coverage").write_text("# pair manifest\n")
+    (tmp_path / "Whole-1.txt").write_text(
+        "testTitle=Whole\ntestName=SaveAndKill\n")
+    (tmp_path / "Whole-2.txt").write_text("testTitle=Whole\n")
+    (tmp_path / "Whole.coverage").write_text("# pair manifest\n")
     findings = run_lint([str(FIXTURE / "ok")], root=REPO_ROOT,
                         spec_dir=str(tmp_path))
     msgs = [f.message for f in findings if f.rule == "coverage-sites"]
     assert any("no such call site" in m for m in msgs), msgs
-    assert any("no matching spec file" in m for m in msgs), msgs
+    orphaned = [f.path for f in findings if f.rule == "coverage-sites"
+                and "no matching spec file" in f.message]
+    assert any("Orphan.coverage" in p for p in orphaned), orphaned
+    assert any("Half.coverage" in p for p in orphaned), orphaned
+    assert not any("Whole.coverage" in p for p in orphaned), orphaned

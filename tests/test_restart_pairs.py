@@ -1,0 +1,694 @@
+"""Restarting test pairs: the SaveAndKill power-kill, reboot-from-disk
+invariants, restart-image torn-save handling, the Rollback workload, and
+the pair plumbing through spec files / soak / cli (the reference's
+tests/restarting/ + SaveAndKill.actor.cpp + tester.actor.cpp:1118
+methodology — part 1 power-kills the whole simulation mid-traffic, part 2
+boots a second process-lifetime from the surviving disks and proves every
+durability claim held across the reboot)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.runtime import buggify, coverage
+from foundationdb_tpu.storage.image import (
+    RestartImageError,
+    load_image,
+    restore_filesystem,
+    save_image,
+)
+from foundationdb_tpu.workloads import spec as spec_mod
+from foundationdb_tpu.workloads.base import Workload, run_workloads
+from foundationdb_tpu.workloads.spec import (
+    is_restarting_pair,
+    resolve_pair,
+    run_restarting_pair,
+    run_spec,
+    run_spec_file,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+RESTARTING = pathlib.Path(__file__).parent / "specs" / "restarting"
+
+P1_MINI = """\
+testTitle=MiniRestart
+seed=5
+shards=2
+
+testName=Cycle
+nodes=6
+clients=2
+txnsPerClient=50
+
+testName=SaveAndKill
+restartAfter=0.8
+"""
+
+P2_MINI = """\
+testTitle=MiniRestart
+
+testName=Cycle
+nodes=6
+clients=1
+txnsPerClient=2
+runSetup=false
+"""
+
+
+def _ring_ok(rows, nodes):
+    kv = dict(rows)
+    if len(kv) != nodes:
+        return False
+    nxt = {int(k.split(b"/")[1]): int(v) for k, v in kv.items()}
+    seen, cur = set(), 0
+    for _ in range(nodes):
+        if cur in seen:
+            return False
+        seen.add(cur)
+        cur = nxt[cur]
+    return cur == 0
+
+
+# ---------------------------------------------------------------------------
+# part 1: the power-kill + image save
+
+
+class TestSaveAndKill:
+    def test_part1_kills_saves_and_reports_phase1(self, tmp_path):
+        m = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        assert m["phase"] == 1
+        assert m["restart_image"] == str(tmp_path / "img")
+        assert m["seed"] == 5
+        # the kill landed MID-traffic: 2x50 rotations cannot finish in
+        # 0.8s, so part 1 must have died with clients still running
+        assert 0 < m["Cycle"]["committed"] < 100
+        files, manifest = load_image(m["restart_image"])
+        assert manifest["seed"] == 5
+        assert manifest["cluster"]["n_storage_shards"] == 2
+        assert manifest["workloads"]["Cycle"] == [{"nodes": 6}]
+        assert manifest["killed_at"] >= 0.8
+        assert [n for n, _kw in manifest["stanzas"]] == ["Cycle", "SaveAndKill"]
+        # the disks are there: storage files, TLog queues, coordinators
+        assert any(p.startswith("ss0") for p in files)
+        assert coverage.hits("restart.power_kill") == 1
+        assert coverage.hits("restart.image_saved") == 1
+
+    def test_part2_boots_from_image_and_ring_holds(self, tmp_path):
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        m2 = run_spec(P2_MINI, restart_image=m1["restart_image"])
+        assert "phase" not in m2  # part 2 ran its checks for real
+        assert m2["Cycle"]["committed"] == 2  # NEW rotations post-reboot
+        assert coverage.hits("restart.booted_from_image") == 1
+        assert coverage.hits("restart.setup_skipped") == 1
+
+    def test_direct_restart_image_read_back(self, tmp_path):
+        """Boot a bare cluster (no spec machinery) from the saved image
+        and walk the ring by hand — the image IS the disks."""
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        files, manifest = load_image(m1["restart_image"])
+        c = RecoverableCluster(
+            seed=manifest["seed"], n_storage_shards=2,
+            fs=restore_filesystem(files), restart=True,
+        )
+        db = c.database()
+
+        async def walk(tr):
+            return await tr.get_range(b"cycle/", b"cycle0", limit=100)
+
+        rows = c.run_until(c.loop.spawn(db.run(walk)), 120)
+        assert _ring_ok(rows, 6), f"ring broken after reboot: {sorted(rows)}"
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# pair resolution + mismatch refusal
+
+
+class TestPairPlumbing:
+    def test_resolution_from_stem_and_either_half(self):
+        stem = str(RESTARTING / "CycleRestart")
+        want = (stem + "-1.txt", stem + "-2.txt")
+        assert resolve_pair(stem) == want
+        assert resolve_pair(stem + "-1.txt") == want
+        assert resolve_pair(stem + "-2.txt") == want
+        assert resolve_pair(stem + "-1") == want
+        assert is_restarting_pair(stem)
+        # a plain spec is not a pair; a missing half is an error
+        assert not is_restarting_pair("tests/specs/CycleTest.txt")
+        with pytest.raises(FileNotFoundError, match="missing"):
+            resolve_pair("tests/specs/CycleTest.txt")
+
+    def test_same_stem_standalones_are_not_a_pair(self, tmp_path):
+        """Two unrelated standalone specs that happen to be named
+        Foo-1.txt/Foo-2.txt are NOT a restarting pair — the -1 half must
+        actually contain a SaveAndKill stanza, or naming alone would
+        hijack them into a bogus pair run and orphan their manifests."""
+        plain = ("testTitle=Foo\ntestName=Cycle\nnodes=4\nclients=1\n"
+                 "txnsPerClient=2\n")
+        (tmp_path / "Foo-1.txt").write_text(plain)
+        (tmp_path / "Foo-2.txt").write_text(plain)
+        assert not is_restarting_pair(str(tmp_path / "Foo-2.txt"))
+        assert not spec_mod.should_run_pair(str(tmp_path / "Foo-2.txt"))
+        # each runs as ITSELF through the spec runner
+        m = run_spec_file(str(tmp_path / "Foo-2.txt"))
+        assert "part1" not in m and m["Cycle"]["committed"] == 2
+        # and keeps its own coverage manifest (no remap to Foo.coverage)
+        from foundationdb_tpu.tools.soak import manifest_for_spec
+
+        (tmp_path / "Foo-2.coverage").write_text("restart.power_kill\n")
+        assert manifest_for_spec(str(tmp_path / "Foo-2.txt")) == str(
+            tmp_path / "Foo-2.coverage")
+
+    def test_run_restarting_pair_on_the_committed_corpus(self, tmp_path):
+        m = run_restarting_pair(
+            str(RESTARTING / "CycleRestart"), image_dir=str(tmp_path / "img"),
+        )
+        assert m["part1"]["phase"] == 1
+        assert m["part2"]["ConsistencyCheck"]["shards_checked"] == 2
+        assert m["seed"] == 101
+        assert os.path.exists(os.path.join(m["restart_image"], "manifest.json"))
+
+    def test_run_spec_file_autodiscovers_the_pair(self, tmp_path,
+                                                  monkeypatch):
+        """run_spec_file given either half (or the bare stem) runs BOTH
+        halves as a pair; explicit save_dir/restart_image kwargs mean the
+        caller drives the halves itself and suppress the discovery."""
+        monkeypatch.setenv("FDBTPU_RESTART_DIR", str(tmp_path / "env-img"))
+        m = run_spec_file(str(RESTARTING / "CycleRestart-1.txt"))
+        assert m["part1"]["phase"] == 1
+        assert m["part2"]["ConsistencyCheck"]["shards_checked"] == 2
+        # the env knob steered the image directory
+        assert (tmp_path / "env-img" / "manifest.json").exists()
+        # explicit save_dir: part 1 runs ALONE and saves there
+        m1 = run_spec_file(str(RESTARTING / "CycleRestart-1.txt"),
+                           save_dir=str(tmp_path / "solo"))
+        assert m1["phase"] == 1 and m1["restart_image"] == str(tmp_path / "solo")
+
+    def test_duplicate_same_named_stanzas_compare_positionally(self):
+        """Two same-named stanzas must not collapse in the manifest: the
+        saved shape is name -> ordered state list, and part 2 pairs its
+        stanzas up positionally (a correct mirror passes, a drifted SECOND
+        stanza still refuses, extra part-2 stanzas are allowed)."""
+        from foundationdb_tpu.workloads.cycle import CycleWorkload
+        from foundationdb_tpu.workloads.save_and_kill import invariant_states
+
+        part1 = [CycleWorkload(nodes=8), CycleWorkload(nodes=4)]
+        saved = invariant_states(part1)
+        assert saved == {"Cycle": [{"nodes": 8}, {"nodes": 4}]}
+        # an exact mirror is NOT a mismatch (the collapsed-dict bug
+        # compared the first stanza against the last saved state)
+        spec_mod._check_restart_states(
+            [CycleWorkload(nodes=8), CycleWorkload(nodes=4)], saved)
+        # extra same-named part-2 stanza: allowed (a new check)
+        spec_mod._check_restart_states(
+            [CycleWorkload(nodes=8), CycleWorkload(nodes=4),
+             CycleWorkload(nodes=2)], saved)
+        with pytest.raises(ValueError, match="restarting-pair mismatch"):
+            spec_mod._check_restart_states(
+                [CycleWorkload(nodes=8), CycleWorkload(nodes=6)], saved)
+        # DROPPING a saved workload is a refusal, not a silent green: the
+        # data rode the reboot, something must re-check it
+        with pytest.raises(ValueError, match="must be re-checked"):
+            spec_mod._check_restart_states([CycleWorkload(nodes=8)], saved)
+        with pytest.raises(ValueError, match="must be re-checked"):
+            spec_mod._check_restart_states([], saved)
+        # JSON-equivalent live state (tuple vs the manifest's list) is NOT
+        # drift — the check canonicalizes through the same round-trip
+        class TupleState(Workload):
+            description = "Tuple"
+
+            def restart_state(self):
+                return {"range": (0, 8)}
+
+        spec_mod._check_restart_states(
+            [TupleState()], {"Tuple": [{"range": [0, 8]}]})
+
+    def test_resave_into_reused_dir_replaces_cleanly(self, tmp_path):
+        """A fixed FDBTPU_RESTART_DIR gets re-saved over: the new image
+        must replace the old one whole — no stale payloads from a larger
+        earlier image, no staging leftovers, and the result loads."""
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        # plant a payload the second save will not contain, and a stale
+        # staging dir a crashed earlier process (any pid) left behind
+        stale = tmp_path / "img" / "files" / "stale-payload"
+        stale.write_bytes(b"old disks")
+        (tmp_path / "img.saving-99999").mkdir()
+        run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        assert not stale.exists()
+        assert not list(tmp_path.glob("img.saving-*"))
+        files, manifest = load_image(m1["restart_image"])
+        assert manifest["seed"] == 5 and any(
+            p.startswith("ss0") for p in files)
+
+    def test_ephemeral_image_dir_cleaned_after_success(self, monkeypatch,
+                                                       tmp_path):
+        """A pair run that DEFAULTED to a temp image dir deletes it once
+        part 2 consumed it; a caller-named dir is kept (it is theirs)."""
+        monkeypatch.delenv("FDBTPU_RESTART_DIR", raising=False)
+        m = run_restarting_pair(str(RESTARTING / "CycleRestart"))
+        # the dir is gone AND the report says so (no dangling path)
+        assert m["restart_image"] is None
+        assert not os.path.exists(m["part1"]["restart_image"])
+        kept = tmp_path / "img"
+        m = run_restarting_pair(str(RESTARTING / "CycleRestart"),
+                                image_dir=str(kept))
+        assert (kept / "manifest.json").exists()
+
+    def test_ephemeral_image_dir_cleaned_when_part1_dies_unsaved(
+            self, monkeypatch, tmp_path):
+        """Part 1 raising BEFORE SaveAndKill saved anything leaves no
+        empty /tmp/fdbtpu-restart-* behind (nothing to triage there)."""
+        import glob as _glob
+
+        monkeypatch.delenv("FDBTPU_RESTART_DIR", raising=False)
+        (tmp_path / "Dead-1.txt").write_text(
+            "testTitle=Dead\ntestName=Cycle\nnodes=6\nclients=1\n"
+            "txnsPerClient=200\n\ntestName=SaveAndKill\nrestartAfter=900\n"
+        )
+        (tmp_path / "Dead-2.txt").write_text(P2_MINI)
+        before = set(_glob.glob("/tmp/fdbtpu-restart-*"))
+        with pytest.raises(Exception):
+            run_restarting_pair(str(tmp_path / "Dead"), deadline=2.0)
+        assert set(_glob.glob("/tmp/fdbtpu-restart-*")) == before
+
+    def test_named_standalone_spec_beats_same_stem_pair(self, tmp_path):
+        """An explicitly named, EXISTING spec always runs as itself — a
+        same-stem -1/-2 pair only substitutes when the path is a bare stem
+        or a pair half (run_spec_file, soak.run_one_seed, and `cli spec`
+        all route through spec.should_run_pair for this)."""
+        standalone = (
+            "testTitle=Solo\ntestName=Cycle\nnodes=4\nclients=1\n"
+            "txnsPerClient=2\n"
+        )
+        (tmp_path / "Solo.txt").write_text(standalone)
+        (tmp_path / "Solo-1.txt").write_text(P1_MINI)
+        (tmp_path / "Solo-2.txt").write_text(P2_MINI)
+        assert not spec_mod.should_run_pair(str(tmp_path / "Solo.txt"))
+        assert spec_mod.should_run_pair(str(tmp_path / "Solo"))
+        assert spec_mod.should_run_pair(str(tmp_path / "Solo-1.txt"))
+        m = run_spec_file(str(tmp_path / "Solo.txt"))
+        assert "part1" not in m and m["Cycle"]["committed"] == 2
+
+    def test_runsetup_typo_is_refused(self):
+        """`runSetup=no` must refuse, not truthy-bool to True — setup
+        re-filling the ring would make part 2 check pristine data instead
+        of the state that rode the reboot."""
+        with pytest.raises(ValueError, match="runSetup expects true/false"):
+            run_spec("testTitle=X\ntestName=Cycle\nnodes=4\nclients=1\n"
+                     "txnsPerClient=1\nrunSetup=no\n")
+
+    def test_part2_with_its_own_kill_is_refused(self, tmp_path):
+        """A SaveAndKill stanza copied into the -2 spec would power-kill
+        part 2 before any check ran — run_restarting_pair must refuse the
+        phase-1-shaped result, not report a green pair that checked
+        nothing."""
+        (tmp_path / "KillTwice-1.txt").write_text(P1_MINI)
+        (tmp_path / "KillTwice-2.txt").write_text(
+            P2_MINI + "\ntestName=SaveAndKill\nrestartAfter=0.5\n")
+        with pytest.raises(ValueError, match="must run checks"):
+            run_restarting_pair(str(tmp_path / "KillTwice"),
+                                image_dir=str(tmp_path / "img"))
+
+    def test_part1_without_kill_is_refused(self, tmp_path):
+        (tmp_path / "NoKill-1.txt").write_text(
+            "testTitle=NoKill\ntestName=Cycle\nnodes=4\nclients=1\n"
+            "txnsPerClient=1\n"
+        )
+        (tmp_path / "NoKill-2.txt").write_text(P2_MINI)
+        with pytest.raises(ValueError, match="without a SaveAndKill"):
+            run_restarting_pair(str(tmp_path / "NoKill"),
+                                image_dir=str(tmp_path / "img"))
+
+    def test_part2_seed_mismatch_refused(self, tmp_path):
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        with pytest.raises(ValueError, match="restarting-pair mismatch.*seed"):
+            run_spec("testTitle=X\nseed=6\ntestName=Cycle\nnodes=6\n"
+                     "runSetup=false\n", restart_image=m1["restart_image"])
+
+    def test_part2_config_mismatch_refused(self, tmp_path):
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        with pytest.raises(ValueError,
+                           match="restarting-pair mismatch.*n_storage_shards"):
+            run_spec("testTitle=X\nshards=3\ntestName=Cycle\nnodes=6\n"
+                     "runSetup=false\n", restart_image=m1["restart_image"])
+        # matching values (including defaulted ones spelled out) are fine
+        m2 = run_spec("testTitle=X\nseed=5\nshards=2\nreplication=2\n"
+                      "testName=Cycle\nnodes=6\nclients=1\ntxnsPerClient=1\n"
+                      "runSetup=false\n", restart_image=m1["restart_image"])
+        assert m2["Cycle"]["committed"] == 1
+
+    def test_part2_workload_state_mismatch_refused(self, tmp_path):
+        m1 = run_spec(P1_MINI, save_dir=str(tmp_path / "img"))
+        with pytest.raises(ValueError,
+                           match="restarting-pair mismatch.*Cycle"):
+            run_spec("testTitle=X\ntestName=Cycle\nnodes=8\nrunSetup=false\n",
+                     restart_image=m1["restart_image"])
+
+    def test_run_setup_spec_key_parses(self):
+        _t, _ck, st = spec_mod.parse_spec(
+            "testName=Cycle\nnodes=6\nrunSetup=false\n"
+        )
+        assert st == [("Cycle", {"nodes": 6, "run_setup": False})]
+
+    def test_run_setup_false_skips_setup_phase(self):
+        class Probe(Workload):
+            description = "Probe"
+            setup_ran = False
+
+            async def setup(self, cluster, rng):
+                self.setup_ran = True
+
+            async def start(self, cluster, rng):
+                pass
+
+        c = RecoverableCluster(seed=11)
+        try:
+            w = Probe()
+            w.run_setup = False
+            run_workloads(c, [w], deadline=60.0)
+            assert not w.setup_ran
+            assert coverage.hits("restart.setup_skipped") == 1
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# torn-save handling
+
+
+class TestTornImages:
+    def _image(self, tmp_path) -> str:
+        return run_spec(P1_MINI, save_dir=str(tmp_path / "img"))["restart_image"]
+
+    def test_missing_manifest_refused(self, tmp_path):
+        img = self._image(tmp_path)
+        os.remove(os.path.join(img, "manifest.json"))
+        with pytest.raises(RestartImageError, match="no manifest.json"):
+            load_image(img)
+
+    def test_torn_manifest_refused(self, tmp_path):
+        img = self._image(tmp_path)
+        mp = os.path.join(img, "manifest.json")
+        blob = open(mp, "rb").read()
+        with open(mp, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+        with pytest.raises(RestartImageError, match="torn or corrupt"):
+            load_image(img)
+
+    def test_corrupt_payload_refused(self, tmp_path):
+        img = self._image(tmp_path)
+        files_dir = os.path.join(img, "files")
+        victim = sorted(
+            p for p in os.listdir(files_dir)
+            if os.path.getsize(os.path.join(files_dir, p)) > 0
+        )[0]
+        with open(os.path.join(files_dir, victim), "r+b") as f:
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(RestartImageError, match="crc32"):
+            load_image(img)
+
+    def test_missing_payload_refused(self, tmp_path):
+        img = self._image(tmp_path)
+        files_dir = os.path.join(img, "files")
+        os.remove(os.path.join(files_dir, sorted(os.listdir(files_dir))[0]))
+        with pytest.raises(RestartImageError, match="payload is missing"):
+            load_image(img)
+
+    def test_percent_escape_paths_round_trip(self, tmp_path):
+        """Manifest keys are RAW sim paths; a path containing a literal
+        %XX sequence must restore under its own name, not a decoded one
+        (review-caught: an unquote() on load silently relocated it)."""
+        from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+        from foundationdb_tpu.storage.files import SimFilesystem
+
+        fs = SimFilesystem(EventLoop(), DeterministicRandom(1))
+        st_path = "wal%41.log"  # unquote() would turn this into walA.log
+        f = fs.open(st_path, None)
+        f.append(b"data")
+        fs.flush_buffers()
+        save_image(fs, str(tmp_path / "img"), {"seed": 1})
+        files, _m = load_image(str(tmp_path / "img"))
+        assert files[st_path] == b"data"
+        assert "walA.log" not in files
+
+    def test_torn_tmp_leftover_is_ignored(self, tmp_path):
+        """The restart.manifest_corrupt shape: a crashed earlier save
+        attempt leaves a torn manifest temp — the loader must read only
+        the atomically-renamed manifest proper."""
+        img = self._image(tmp_path)
+        with open(os.path.join(img, "manifest.json.tmp"), "wb") as f:
+            f.write(b'{"format": 1, "files": {"gar')
+        files, manifest = load_image(img)
+        assert manifest["seed"] == 5 and files
+
+    def test_buggified_torn_save_still_loads(self, tmp_path):
+        """Under chaos, SaveAndKill's setup arms restart.manifest_corrupt
+        with a seeded coin: the save then plants the torn temp itself,
+        fires the census, and the image still boots.  (Arming outside
+        run_spec is impossible by design — the cluster's chaos setup owns
+        the buggify state — so scan the seed matrix for an armed seed.)"""
+        p1_chaos = P1_MINI.replace("seed=5\n", "seed=5\nchaos=true\n")
+        img = None
+        for seed in range(3000, 3020):
+            cand = str(tmp_path / f"img{seed}")
+            run_spec(p1_chaos, seed=seed, save_dir=cand)
+            if os.path.exists(os.path.join(cand, "manifest.json.tmp")):
+                img = cand
+                break
+        assert img is not None, (
+            "no seed in 3000..3019 armed restart.manifest_corrupt — the "
+            "seeded coin is broken"
+        )
+        assert coverage.hits("buggify.restart.manifest_corrupt") >= 1
+        m2 = run_spec(P2_MINI, restart_image=img)
+        assert m2["Cycle"]["committed"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the crash model, pinned from both directions
+
+
+class TestCrashDurability:
+    def _mk(self, seed):
+        c = RecoverableCluster(seed=seed, n_storage_shards=2)
+        db = c.database()
+
+        async def committed_write(tr):
+            tr.set(b"acked/key", b"promised")
+
+        c.run_until(c.loop.spawn(db.run(committed_write)), 60)
+        # a deliberately buffered, never-fsynced write on a live machine's
+        # disk — page-cache-only data with NO durability promise attached
+        proc = next(p for p in c.net.processes.values() if p.alive)
+        f = c.fs.open("negative.probe", proc)
+        f.append(b"BUFFERED-NEVER-SYNCED")
+        return c
+
+    def test_unsynced_write_must_not_survive_the_power_kill(self, tmp_path):
+        """The negative direction: the power-kill is UNCLEAN by contract —
+        buffered-but-unsynced data dies with it.  (If SaveAndKill's kill
+        were secretly a clean shutdown, this test is exactly the one that
+        would fail — see the clean-shutdown twin below.)"""
+        c = self._mk(21)
+        fs = c.power_off()
+        save_image(fs, str(tmp_path / "img"), {"seed": 21})
+        files, _m = load_image(str(tmp_path / "img"))
+        assert files["negative.probe"] == b"", (
+            "un-fsynced page-cache data survived a power kill — the kill "
+            "is not unclean"
+        )
+        # ...while the ACKED commit must be in the image (ack => fsynced)
+        c2 = RecoverableCluster(seed=22, n_storage_shards=2,
+                                fs=restore_filesystem(files), restart=True)
+        db2 = c2.database()
+
+        async def read(tr):
+            return await tr.get(b"acked/key")
+
+        assert c2.run_until(c2.loop.spawn(db2.run(read)), 120) == b"promised"
+        c2.stop()
+
+    def test_same_write_survives_a_clean_shutdown(self, tmp_path):
+        """The discriminating twin: replace the power-kill with an orderly
+        flush-then-halt and the SAME buffered write now survives — proving
+        the previous test actually discriminates kill from shutdown."""
+        c = self._mk(23)
+        fs = c.clean_shutdown()
+        save_image(fs, str(tmp_path / "img"), {"seed": 23})
+        files, _m = load_image(str(tmp_path / "img"))
+        assert files["negative.probe"] == b"BUFFERED-NEVER-SYNCED"
+
+    def test_acked_commits_survive_kill_at_any_offset(self, tmp_path):
+        """The positive direction, swept: commits acknowledged while the
+        power-kill timer runs must ALL be readable after the reboot — a
+        write whose fsync was still in flight at the kill either survived
+        or was never acknowledged, never a third thing."""
+        for offset in (0.05, 0.3, 1.0):
+            c = RecoverableCluster(seed=31, n_storage_shards=2)
+            db = c.database()
+            acked: dict[bytes, bytes] = {}
+
+            async def writer(ci):
+                from foundationdb_tpu.client.transaction import RETRYABLE_ERRORS
+                from foundationdb_tpu.roles.types import CommitUnknownResult
+
+                for seq in range(1000):
+                    key = b"acked/%d/%04d" % (ci, seq)
+                    tr = db.create_transaction()
+                    while True:
+                        try:
+                            tr.set(key, b"v")
+                            await tr.commit()
+                            acked[key] = b"v"
+                            break
+                        except CommitUnknownResult:
+                            break  # either outcome legal: not recorded
+                        except RETRYABLE_ERRORS as e:
+                            await tr.on_error(e)
+
+            for ci in range(2):
+                c.loop.spawn(writer(ci))
+            c.run_until(c.loop.delay(0.2 + offset), 120)
+            assert acked, f"offset={offset}: nothing acked before the kill"
+            fs = c.power_off()
+            save_image(fs, str(tmp_path / f"img{offset}"), {"seed": 31})
+            files, _m = load_image(str(tmp_path / f"img{offset}"))
+            c2 = RecoverableCluster(seed=32, n_storage_shards=2,
+                                    fs=restore_filesystem(files),
+                                    restart=True)
+            db2 = c2.database()
+
+            async def read_all(tr):
+                return {k: await tr.get(k) for k in acked}
+
+            got = c2.run_until(c2.loop.spawn(db2.run(read_all)), 120)
+            lost = [k for k, v in acked.items() if got.get(k) != v]
+            assert not lost, (
+                f"offset={offset}: {len(lost)} ACKED commits lost across "
+                f"the reboot, e.g. {sorted(lost)[:3]}"
+            )
+            c2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rollback workload
+
+
+class TestRollback:
+    def test_rollback_forces_recovery_and_loses_nothing_acked(self):
+        m = run_spec(
+            "testTitle=RollbackUnit\nseed=41\nshards=2\n\n"
+            "testName=Rollback\nrounds=2\nclients=2\nwritesPerClient=8\n",
+            deadline=600.0,
+        )
+        r = m["Rollback"]
+        assert r["forced_recoveries"] >= 1
+        assert r["acked"] + r["unknown"] == 16
+        assert coverage.hits("rollback.forced_recovery") >= 1
+
+    def test_rollback_check_fails_without_a_forced_recovery(self):
+        """A Rollback whose kills never landed must FAIL its check (a
+        rollback test that never rolled back tested nothing)."""
+        from foundationdb_tpu.workloads.rollback import RollbackWorkload
+
+        c = RecoverableCluster(seed=43)
+        try:
+            w = RollbackWorkload(rounds=0, clients=1, writes_per_client=2)
+            with pytest.raises(AssertionError, match="Rollback"):
+                run_workloads(c, [w], deadline=120.0)
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# the supervised device backend crossed with the whole-sim kill
+
+
+class TestSupervisedPipelineKill:
+    def test_pair_with_split_phase_resolver_mid_pipeline(self, tmp_path,
+                                                          monkeypatch):
+        """FDBTPU_PIPELINE=1 + backend=supervised: the power-kill lands
+        while the split-phase resolver may hold an open deferred window on
+        the device — the composition the deferred-window replay had never
+        been crossed with.  The pair must still prove the ring."""
+        monkeypatch.setenv("FDBTPU_PIPELINE", "1")
+        m = run_restarting_pair(
+            str(RESTARTING / "RestartAttritionSwizzle"), seed=3100,
+            image_dir=str(tmp_path / "img"),
+        )
+        assert m["part1"]["phase"] == 1
+        assert m["part2"]["ConsistencyCheck"]["shards_checked"] == 2
+
+
+# ---------------------------------------------------------------------------
+# soak + cli integration
+
+
+class TestHarnessIntegration:
+    def test_soak_runs_pair_as_one_seeded_unit(self, tmp_path):
+        """A 2-seed campaign over the committed CycleRestart pair: both
+        halves run in the same worker with a shared artifact dir, the
+        image lands under the seed's artifacts, the merged census crosses
+        both lifetimes, and every required kill/reboot site is hit."""
+        from foundationdb_tpu.tools import soak
+
+        # seeds chosen so the pair's seeded coins cover BOTH buggify
+        # sites across the campaign (3000 arms kill_point, 3002 arms
+        # manifest_corrupt) — the committed 100-seed campaign report in
+        # docs/campaigns/ shows the unchosen-matrix rates
+        report = soak.run_campaign(
+            str(RESTARTING / "CycleRestart"), [3000, 3002],
+            str(tmp_path / "out"), jobs=2, seed_deadline=240.0,
+            keep_traces=True,
+        )
+        assert report["ok"], report["coverage"]["missing_required"]
+        assert report["verdicts"]["pass"] == 2
+        merged = report["coverage"]["merged"]
+        assert merged["testcov"]["restart.power_kill"]["hit_seeds"] == 2
+        assert merged["testcov"]["restart.booted_from_image"]["hit_seeds"] == 2
+        # the image is a per-seed artifact next to the seed's traces
+        assert (tmp_path / "out" / "seed-3000" / "image"
+                / "manifest.json").exists()
+
+    def test_manifest_for_spec_pair_vs_standalone_stems(self, tmp_path):
+        """A pair shares `<stem>.coverage`; a STANDALONE spec whose name
+        merely ends in -1/-2 keeps its own manifest (review-caught: the
+        unconditional strip silently dropped required-coverage gating)."""
+        from foundationdb_tpu.tools import soak
+
+        pair = str(RESTARTING / "CycleRestart-1.txt")
+        assert soak.manifest_for_spec(pair) == str(
+            RESTARTING / "CycleRestart.coverage")
+        solo = tmp_path / "Foo-2.txt"
+        solo.write_text("testName=Cycle\n")
+        (tmp_path / "Foo-2.coverage").write_text("recovery.triggered\n")
+        assert soak.manifest_for_spec(str(solo)) == str(
+            tmp_path / "Foo-2.coverage")
+
+    def test_cli_spec_subcommand_runs_a_pair(self, tmp_path):
+        import subprocess
+
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=str(REPO) + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        )
+        p = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.tools.cli", "spec",
+             str(RESTARTING / "CycleRestart"), "--seed", "3200",
+             "--image-dir", str(tmp_path / "img")],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        m = json.loads(p.stdout)
+        assert m["seed"] == 3200
+        assert m["part1"]["phase"] == 1
+        assert m["part2"]["ConsistencyCheck"]["shards_checked"] == 2
