@@ -60,14 +60,69 @@ async def get_configuration(db) -> dict:
         out = {}
         for k, v in rows:
             name = k[len(CONF_PREFIX):]
-            if b"/" in name or name in (b"lock", b"coordinators"):
-                continue  # excluded/…, maintenance/…, lock, quorum size:
-                          # not role counts
+            if b"/" in name or name in (
+                b"lock", b"coordinators", b"usable_regions",
+            ):
+                continue  # excluded/…, maintenance/…, region/…, lock,
+                          # quorum size, usable_regions: not role counts
             try:
                 out[name.decode()] = int(v)
             except ValueError:
                 continue
         return out
+
+    return await db.run(fn)
+
+
+# -- region configuration (configure usable_regions=2 / region failover) -----
+
+
+async def configure_regions(db, usable_regions: int | None = None,
+                            satellite: str | None = None,
+                            primary: str | None = None) -> None:
+    """Commit region configuration (control/region.py): `usable_regions=2`
+    makes the remote region part of the durability contract (the log-router
+    tag becomes recovery-required), `satellite` tunes that requirement, and
+    flipping `primary="remote"` IS region failover — the controller's conf
+    watch drives the promotion (the KillRegion.actor.cpp contract: configure
+    the region change, never poke the topology by hand).  Unnamed fields
+    keep their committed values."""
+    from ..control.region import (
+        PRIMARY_KEY,
+        SATELLITE_KEY,
+        USABLE_REGIONS_KEY,
+        RegionConfiguration,
+    )
+
+    # validate the named fields against the full vocabulary up front —
+    # a typo'd mode must fail HERE, not sit unparseable in the keyspace
+    RegionConfiguration(
+        usable_regions=2 if usable_regions is None else usable_regions,
+        satellite="required" if satellite is None else satellite,
+        primary="primary" if primary is None else primary,
+    ).validate()
+
+    async def fn(tr):
+        if usable_regions is not None:
+            tr.set(USABLE_REGIONS_KEY, b"%d" % usable_regions)
+        if satellite is not None:
+            tr.set(SATELLITE_KEY, satellite.encode())
+        if primary is not None:
+            tr.set(PRIMARY_KEY, primary.encode())
+
+    await db.run(fn)
+
+
+async def get_region_configuration(db):
+    """The committed RegionConfiguration, or None if never configured."""
+    from ..control.region import REGION_PREFIX, USABLE_REGIONS_KEY, parse_region_rows
+
+    async def fn(tr):
+        rows = list(await tr.get_range(REGION_PREFIX, REGION_PREFIX + b"\xff"))
+        v = await tr.get(USABLE_REGIONS_KEY)
+        if v is not None:
+            rows.append((USABLE_REGIONS_KEY, v))
+        return parse_region_rows(rows)
 
     return await db.run(fn)
 

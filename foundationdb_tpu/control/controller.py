@@ -57,7 +57,7 @@ from ..runtime.core import (
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
 from ..runtime.coverage import testcov
-from .logsystem import LogSystem
+from .logsystem import LogSystem, region_required_tags, remap_router_entries
 
 
 def parse_conf_rows(rows) -> dict:
@@ -73,6 +73,8 @@ def parse_conf_rows(rows) -> dict:
         MAINTENANCE_PREFIX,
     )
 
+    from .region import REGION_PREFIX, USABLE_REGIONS_KEY, region_rows_present
+
     conf: dict[str, int] = {}
     excluded: set[str] = set()
     locked: bytes | None = None
@@ -80,7 +82,10 @@ def parse_conf_rows(rows) -> dict:
     maint: dict[str, float] = {}
     redundancy: str | None = None
     throttle: float | None = None
+    rows = list(rows)
     for k, v in rows:
+        if k == USABLE_REGIONS_KEY or k.startswith(REGION_PREFIX):
+            continue  # decoded as a whole by parse_region_rows below
         if k.startswith(EXCLUDED_PREFIX):
             excluded.add(k[len(EXCLUDED_PREFIX):].decode())
             continue
@@ -119,6 +124,11 @@ def parse_conf_rows(rows) -> dict:
         "conf": conf, "excluded": excluded, "locked": locked,
         "coord_n": coord_n, "maint": maint, "redundancy": redundancy,
         "throttle": throttle,
+        # presence only: the conf WATCH decodes the region rows itself with
+        # the APPLIED config as the torn-row fallback base — a decoded-
+        # without-base config here would carry the default-decay semantics
+        # the base= parameter exists to avoid
+        "region_rows": rows if region_rows_present(rows) else None,
     }
 
 
@@ -247,6 +257,19 @@ class ClusterController:
         self.maintenance_zones: dict[str, float] = {}  # zone -> deadline
         self.replication_policy = None      # installed by the cluster assembly
         self.on_redundancy_change = None    # async (policy) -> bool (one step)
+        # region configuration (control/region.py): the in-memory mirror of
+        # the committed `\xff/conf/` region rows; the cluster assembly
+        # installs the change hook (it owns router/remote-replica topology)
+        from .region import RegionConfiguration
+
+        self.region_config = RegionConfiguration()
+        self.on_region_change = None        # async (new, old) -> bool
+        # live storage replicas OUTSIDE the keyServers teams that also hold
+        # the `\xff/conf/` shard (the remote region's replicas): the conf
+        # watch reads through them when every primary replica of the shard
+        # is dead — a region kill must not blind the watch to the very
+        # failover configuration that recovers from it
+        self.conf_fallback_servers: list = []
         # cluster-wide liveness map (fdbrpc/FailureMonitor.h:65): fed by the
         # heartbeats below + data distribution's storage pings, consulted by
         # client load-balancing through every view
@@ -458,20 +481,32 @@ class ClusterController:
         finally:
             self._recovering = False
 
-    def _read_conf_rows_from_storage(self) -> list[tuple[bytes, bytes]]:
+    def _read_conf_rows_from_storage(
+        self, fallback: bool = False
+    ) -> list[tuple[bytes, bytes]]:
         """Direct host-side read of the `\\xff/conf/` range from the storage
         team that owns it (the txnStateStore-recovery analog: the reference
         master reloads configuration from the recovered txn state store
         before accepting commits).  Best-effort: an unreachable team means
-        the conf watch corrects state one poll later, as before."""
+        the conf watch corrects state one poll later, as before.  With
+        `fallback`, remote-region replicas of the conf shard are consulted
+        after the team — the read path a whole-region kill leaves alive."""
         from ..client.management import CONF_PREFIX
 
         begin, end = CONF_PREFIX, CONF_PREFIX + b"\xff"
         try:
-            team = self._storage_teams()[-1]  # `\xff` sorts into the last shard
+            team = list(self._storage_teams()[-1])  # `\xff`: the last shard
         except Exception:  # noqa: BLE001 — malformed team map: skip
-            return []
-        for ss in team:
+            team = []
+            if not fallback:
+                return []
+        candidates = list(team)
+        n_primary = len(candidates)
+        if fallback:
+            candidates += [
+                s for s in self.conf_fallback_servers if s not in candidates
+            ]
+        for idx, ss in enumerate(candidates):
             if not ss.process.alive:
                 continue
             try:
@@ -482,6 +517,12 @@ class ClusterController:
                     v = ss.overlay.get(k, ss.version.get(), ss.store.get)
                     if v is not None:
                         rows.append((k, v))
+                if idx >= n_primary:
+                    # served by a REMOTE replica with the whole primary
+                    # team dead/unreadable — the region-kill read path the
+                    # coverage site exists to pin (a live-primary blip
+                    # served above must not satisfy it)
+                    testcov("region.conf_read_fallback")
                 return rows
             except Exception:  # noqa: BLE001 — mid-reboot store: next replica
                 continue
@@ -531,6 +572,12 @@ class ClusterController:
         }
         if self.ratekeeper is not None:
             self.ratekeeper.manual_tps_cap = parsed["throttle"]
+        # region rows are deliberately NOT adopted here: region_config
+        # mirrors the APPLIED topology (set by the cluster assembly from
+        # what it actually built/recovered), and the conf watch drives the
+        # region hook on any desired-vs-applied drift — a reboot that
+        # interrupted a configured failover re-runs it instead of
+        # remembering it as done
         self.trace.trace(
             "ConfigurationRecovered", Epoch=self.epoch,
             Locked=self._locked is not None,
@@ -556,10 +603,15 @@ class ClusterController:
         # required_tags unconditionally: a MEMORY-engine cluster has no disk
         # fallback, so losing every replica slot of a storage tag is exactly
         # as unrecoverable as on disk — recovery must refuse loudly instead
-        # of silently dropping the tag's unpopped data (ADVICE round 5)
+        # of silently dropping the tag's unpopped data (ADVICE round 5).
+        # Under usable_regions=2 the router tag joins the set: its retained
+        # backlog is the remote region's not-yet-durable data.
         recovery_version, replies = await ls.lock(
             self.net, self._cc_proc(), self.fs,
-            required_tags=[s.tag for s in self.storage],
+            required_tags=region_required_tags(
+                [s.tag for s in self.storage], self.region_config,
+                self.stream_consumers,
+            ),
         )
         seeds = LogSystem.merge_replies(
             replies, recovery_version, self.n_tlogs, self._keep_tag
@@ -577,8 +629,30 @@ class ClusterController:
         replay every old slot's file."""
         recovery_version, replies, _ls = LogSystem.from_disk(
             self.fs, prev_epoch, prev_n_tlogs, prev_paths,
-            required_tags=[s.tag for s in self.storage],
+            required_tags=region_required_tags(
+                [s.tag for s in self.storage], self.region_config,
+                self.stream_consumers,
+            ),
         )
+        from ..roles.logrouter import ROUTER_TAG
+        from .region import teams_promoted
+
+        if (
+            teams_promoted(self.storage_teams_tags)
+            and ROUTER_TAG not in self.stream_consumers
+        ):
+            # a PROMOTED reboot with retained router data: the power kill
+            # landed inside the post-failover durability window, so the
+            # promoted replicas still owe their disks the stream the
+            # router was retaining — fold it into their tags' seeds
+            # instead of dropping the only durable copy
+            remap_router_entries(
+                replies,
+                KeyPartitionMap(
+                    list(self.storage_splits),
+                    [list(t) for t in self.storage_teams_tags],
+                ),
+            )
         seeds = LogSystem.merge_replies(
             replies, recovery_version, self.n_tlogs, self._keep_tag
         )
@@ -1351,8 +1425,16 @@ class ClusterController:
                 rows = await tr.get_range(CONF_PREFIX, CONF_PREFIX + b"\xff")
             except ActorCancelled:
                 raise  # stop() cancelled the watch: exit, don't zombie-poll
-            except Exception:  # noqa: BLE001 — recovery window; retry next tick
-                continue
+            except Exception:  # noqa: BLE001 — recovery window; retry next
+                # tick — unless a remote-region replica of the conf shard
+                # can still serve: a whole-region kill takes out every
+                # primary replica of `\xff/conf/`, and the watch must still
+                # be able to READ the failover configuration committed to
+                # recover from exactly that kill (commits only need the
+                # pipeline, which is alive)
+                rows = self._read_conf_rows_from_storage(fallback=True)
+                if not rows:
+                    continue
             parsed = parse_conf_rows(rows)
             conf = parsed["conf"]
             excluded = parsed["excluded"]
@@ -1460,6 +1542,34 @@ class ClusterController:
                                 "RedundancyChanged", Mode=redundancy,
                                 Epoch=self.epoch,
                             )
+            # region configuration (configure_regions): enabling a second
+            # region or flipping the primary runs through the assembly's
+            # hook as a BACKGROUND step, like redundancy — a failover's
+            # convergence wait (remote replicas catching the promotion
+            # boundary) can take seconds and must not starve the watch.
+            # Parsed against the APPLIED config as the base: a torn
+            # region row must hold the current value, never decay to the
+            # defaults (a decayed usable_regions=1 would read as a
+            # legitimate request to dismantle the remote durability plane)
+            from .region import parse_region_rows
+
+            regions = (
+                parse_region_rows(parsed["region_rows"],
+                                  base=self.region_config)
+                if parsed["region_rows"] is not None else None
+            )
+            if (
+                regions is not None
+                and regions != self.region_config
+                and self.on_region_change is not None
+            ):
+                t = getattr(self, "_region_change_task", None)
+                if t is None or t.done():
+                    self._region_change_task = self.loop.spawn(
+                        self._region_step(regions),
+                        TaskPriority.COORDINATION, "cc-region",
+                    )
+
             want_tlogs = conf.get("n_tlogs", len(gen.tlogs))
             want_proxies = conf.get("n_proxies", len(gen.proxies))
             want_res = conf.get("n_resolvers", len(gen.resolvers))
@@ -1486,6 +1596,28 @@ class ClusterController:
                 raise  # teardown, not a failed reconfiguration
             except Exception:  # noqa: BLE001 — next poll re-detects the
                 continue       # actual-vs-desired mismatch and retries
+
+    async def _region_step(self, regions) -> None:
+        """One region-configuration change, off the conf watch's critical
+        path (the failover half of KillRegion.actor.cpp: the configure
+        commit is the trigger, this applies it)."""
+        old = self.region_config
+        try:
+            if await self.on_region_change(regions, old):
+                self.region_config = regions
+                testcov("region.config_applied")
+                self.trace.trace(
+                    "RegionConfigurationChanged",
+                    UsableRegions=regions.usable_regions,
+                    Satellite=regions.satellite, Primary=regions.primary,
+                    Epoch=self.epoch,
+                )
+        except ActorCancelled:
+            raise  # stop() cancelling a mid-flight failover is teardown,
+                   # not a failed change — the promotion must die HERE
+        except Exception as e:  # noqa: BLE001 — next poll re-detects the
+            # configured-vs-applied mismatch and respawns the step
+            self.trace.trace("RegionConfigurationError", Error=repr(e))
 
     async def _redundancy_step(self, policy) -> None:
         """One replica-change step, off the conf watch's critical path."""
@@ -1534,6 +1666,10 @@ class ClusterController:
                     )
 
     def stop(self) -> None:
+        if getattr(self, "_region_change_task", None) is not None:
+            # a mid-flight region failover dies with its controller — the
+            # promotion's convergence wait must never outlive stop()
+            self._region_change_task.cancel()
         if getattr(self, "_redundancy_step_task", None) is not None:
             self._redundancy_step_task.cancel()
         if getattr(self, "_register_task", None) is not None:

@@ -148,14 +148,36 @@ class DataDistributor:
         ranges: list[tuple[bytes, bytes | None, list[str]]] = []
         for i, team in enumerate(cc.storage_teams_tags):
             if tag in team:
-                srcs = [t for t in team if t != tag]
+                # sources must be ALIVE, not merely named: with the whole
+                # team dead (a region kill), healing would ground (clear)
+                # the dead replica's recovered disk against a source that
+                # can never answer — gutting the last durable copy of the
+                # shard before any replacement holds it.  The disks must
+                # stay untouched so a reboot-from-disk (or a region
+                # failover to the remote replicas) still has every byte.
+                srcs = [
+                    t for t in team
+                    if t != tag and cc._tag_to_ss[t].process.alive
+                ]
                 if not srcs:
+                    testcov("dd.heal_no_live_source")
                     cc.trace.trace(
                         "DDHealImpossible", Tag=tag, Shard=i,
-                        Reason="no surviving replica",
+                        Reason="no live source replica",
                     )
                     return
                 ranges.append((bounds[i], bounds[i + 1], srcs))
+        if not ranges:
+            # a server whose tag sits in NO team (a promotion or move is
+            # mid-install) must not be "healed": the replacement would have
+            # nothing to fetch, steal the store file, and stamp an empty
+            # store with an advancing durable_version — a lying disk the
+            # next reboot trusts
+            testcov("dd.heal_no_range")
+            cc.trace.trace(
+                "DDHealImpossible", Tag=tag, Reason="tag serves no range",
+            )
+            return
         self._heal_seq += 1
         dead.stop()  # before reopening its store file: no straggler writes
         extra = {}

@@ -30,6 +30,67 @@ from ..runtime.core import BrokenPromise, TimedOut
 from ..runtime.coverage import testcov
 
 
+def region_required_tags(storage_tags: list[str], region_config,
+                         stream_consumers) -> list[str]:
+    """The required-tag set recovery refuses to lose, grown by the region
+    configuration (control/region.py): under `usable_regions=2` with the
+    satellite requirement, the log-router tag's retained backlog — commits
+    acked locally but not yet durable in the remote region — is part of
+    the durability contract, so losing every replica slot of it must abort
+    recovery exactly like losing a storage tag would.  Consumed by both
+    epoch-end paths (live lock and whole-cluster restart from disk)."""
+    tags = list(storage_tags)
+    if region_config is not None and region_config.router_tag_required:
+        from ..roles.logrouter import ROUTER_TAG
+
+        if ROUTER_TAG in stream_consumers:
+            testcov("region.router_tag_required")
+            tags.append(ROUTER_TAG)
+    return tags
+
+
+def remap_router_entries(replies: list, remote_map) -> int:
+    """Fold retained log-router entries into the REMOTE tags' recovery
+    seeds (the promoted-reboot half of the router retention contract).
+
+    After a region failover, the promoted replicas' newest data is held
+    back from their disks by the MVCC window — for that window the only
+    durable copy a reboot can re-serve them is the router tag's retained
+    backlog (mutations <= the promotion boundary carry only primary and
+    router tags; the replicas' own tags start ABOVE it).  A whole-sim
+    power kill inside the window therefore lands here: re-tag each
+    retained router mutation by key through the promoted key map —
+    exactly the re-tagging the live router performed — so merge_replies
+    seeds the replicas' tags with the stream they still owe their disks.
+    Entries drain from the reply dicts (the router tag itself stays
+    droppable); duplicate versions against the replicas' own tags are
+    deduplicated by merge_replies.  Returns the entry count remapped."""
+    from ..roles.logrouter import ROUTER_TAG
+    from ..roles.types import MutationType
+
+    remapped = 0
+    for r in replies:
+        if r is None or ROUTER_TAG not in r.tags:
+            continue
+        entries = r.tags.pop(ROUTER_TAG)
+        for version, muts in entries:
+            by_tag: dict[str, list] = {}
+            for m in muts:
+                if m.type == MutationType.CLEAR_RANGE:
+                    teams = remote_map.members_for_range(m.key, m.value)
+                else:
+                    teams = [remote_map.member_for_key(m.key)]
+                for team in teams:
+                    for t in team:
+                        by_tag.setdefault(t, []).append(m)
+            for t, tmuts in by_tag.items():
+                r.tags.setdefault(t, []).append((version, tmuts))
+            remapped += 1
+    if remapped:
+        testcov("region.router_seed_remap")
+    return remapped
+
+
 class LogSystem:
     """One epoch's TLog set (tag-partitioned, 2x replicated)."""
 

@@ -15,7 +15,12 @@ from ..conflict.oracle import OracleConflictSet
 from ..roles.storage import MemoryKeyValueStore, StorageServer
 from ..rpc.network import SimNetwork
 from ..rpc.stream import RequestStreamRef
-from ..runtime.core import DeterministicRandom, EventLoop
+from ..runtime.core import (
+    ActorCancelled,
+    DeterministicRandom,
+    EventLoop,
+    TaskPriority,
+)
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
 from .controller import ClusterController
@@ -79,7 +84,15 @@ class RecoverableCluster:
         remote_region: bool = False,  # a second region: a log router pulls
                                 # the full stream once and re-serves it to
                                 # remote read replicas of every shard
-                                # (LogRouter.actor.cpp + remote tLogs)
+                                # (LogRouter.actor.cpp + remote tLogs).
+                                # Equivalent to usable_regions=2.
+        usable_regions: int = 1,  # region-configuration bootstrap
+                                # (control/region.py): 2 builds the remote
+                                # plane AND makes the router tag part of
+                                # the recovery durability contract; the
+                                # committed `\xff/conf/` region rows (and
+                                # a restart's recovered keyServers map)
+                                # override this at runtime
         redundancy: str | None = None,  # declarative mode ("single"/"double"/
                                 # "triple"/"three_datacenter"): sets the
                                 # replication factor AND the placement policy
@@ -336,8 +349,19 @@ class RecoverableCluster:
 
         self.log_router = None
         self.remote_storage: list[StorageServer] = []
-        if remote_region:
-            self._make_log_router(n_storage_shards)
+        self._n_storage_shards = n_storage_shards
+        self._region_task = None          # a tracked mid-flight promotion
+        self._region_promoted = False
+        # birth/reboot remote planes carry a structurally complete stream
+        # (the router consumer predates generation 1); an ONLINE enable
+        # flips this False until its history fetch lands
+        self._remote_history_complete = True
+        self.controller.on_region_change = self._on_region_change
+        if remote_region or usable_regions >= 2:
+            # BEFORE the boot recovery: a promoted reboot must resolve
+            # remote tags in the recovered keyServers map, and the router
+            # consumer must be registered before the first TLog seed filter
+            self._prepare_remote_region(restart)
 
         # worker pool + fdbmonitor analog (fdbmonitor/fdbmonitor.cpp: the
         # supervisor that restarts dead fdbserver processes; here a dead
@@ -409,8 +433,6 @@ class RecoverableCluster:
         # `configure redundancy=` flips replication online through data
         # distribution (add/remove one replica per conf poll until converged)
         self.controller.on_redundancy_change = self.dd.converge_redundancy
-        if remote_region:
-            self._make_remote_storage(n_storage_shards, make_store)
         # spawned LAST: an __init__ that raises above (team policy refusals,
         # bad config) must not leak a never-started emitter task — nothing
         # would ever cancel it
@@ -499,22 +521,105 @@ class RecoverableCluster:
                         i, self._worker_classes[i], reg_ep
                     )
 
-    def _make_log_router(self, n_storage_shards: int) -> None:
-        """Pre-start half of the remote region: the router is REGISTERED as
-        a full-stream consumer before the first recovery, so generation 1
-        (and a restart's disk recovery) carries its tag from the start —
-        the stream is complete over the cluster's whole life."""
+    def _prepare_remote_region(self, restart: bool,
+                               register_router: bool = True) -> None:
+        """Build the second region BEFORE the boot recovery (the
+        region-configuration bootstrap, control/region.py):
+
+          * remote replicas first, so a restart whose recovered keyServers
+            map names remote tags — the cluster had already failed over
+            when it was power-killed — resolves them instead of silently
+            falling back to the tag-convention map (which would boot the
+            WRONG serving set against the promoted disks),
+          * on a promoted reboot the replicas join the controller's
+            serving set and no router is built (the relay ended with the
+            failover); otherwise the router is registered as a full-stream
+            consumer so generation 1 (and a restart's disk recovery)
+            carries its tag from the start,
+          * the controller's in-memory region config reflects the built
+            topology until the recovered `\\xff/conf/` rows override it.
+        """
+        from ..rpc.stream import RequestStreamRef as _Ref
+        from .region import RegionConfiguration
+
+        cc = self.controller
+        n = self._n_storage_shards
+        self.remote_storage = []
+        for i in range(n):
+            p = self.net.create_process(f"remote-storage-{i}")
+            # recover-if-exists: right for every entry path (fresh cluster,
+            # reboot, and an online enable over previously saved disks)
+            store = self._make_store_recover(f"remote{i}.kv", p)
+            ss = StorageServer(
+                p, self.loop, self.knobs,
+                tlog_peek_ref=None, tlog_pop_ref=None,
+                tag=f"remote-{i}-r0", store=store,
+                start_version=(
+                    store.meta.get("durable_version", 0)
+                    if self.fs is not None else 0
+                ),
+            )
+            ss.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
+            self.remote_storage.append(ss)
+        promoted = False
+        if restart and self.fs is not None and self.fs.exists(cc.KEYSERVERS_PATH):
+            from .region import teams_promoted
+
+            for ss in self.remote_storage:
+                cc._tag_to_ss.setdefault(ss.tag, ss)
+            cc._recover_key_servers()
+            promoted = teams_promoted(cc.storage_teams_tags)
+        self._region_promoted = promoted
+        primary = "primary"
+        if promoted:
+            from ..runtime.coverage import testcov
+
+            testcov("region.promoted_reboot")
+            primary = "remote"
+            # the promoted replicas ARE the serving set: recovery's
+            # required tags and the boot _rewire must cover them
+            for ss in self.remote_storage:
+                cc._tag_to_ss[ss.tag] = ss
+                if ss not in cc.storage:
+                    cc.storage.append(ss)
+        else:
+            # register_router=False: the ONLINE enable path must instead
+            # go through enable_stream_consumer's drain barrier (which
+            # tags the live proxies and wires the TLog source)
+            self._build_log_router(register=register_router)
+            for ss in self.remote_storage:
+                ss.set_tlog_source(
+                    _Ref(self.net, ss.process, self.log_router.peek_stream.endpoint),
+                    _Ref(self.net, ss.process, self.log_router.pop_stream.endpoint),
+                )
+        # the conf watch can read `\xff/conf/` through the remote replica
+        # of its shard when the whole primary region is dead (`\xff` sorts
+        # into the last shard)
+        cc.conf_fallback_servers = self.remote_storage[-1:]
+        cc.region_config = RegionConfiguration(
+            usable_regions=2, primary=primary
+        )
+
+    def _build_log_router(self, replacement: bool = False,
+                          register: bool = True) -> None:
         from ..roles.logrouter import ROUTER_TAG, LogRouter
         from ..roles.proxy import KeyPartitionMap
 
         splits = self._initial_storage_splits
-        remote_tags = [[f"remote-{i}-r0"] for i in range(n_storage_shards)]
-        rproc = self.net.create_process("log-router-0")
+        remote_tags = [[s.tag] for s in self.remote_storage] or [
+            [f"remote-{i}-r0"] for i in range(len(splits) + 1)
+        ]
+        suffix = (
+            f"-{self.rng.random_unique_id()[:4]}" if replacement else "-0"
+        )
+        rproc = self.net.create_process(f"log-router{suffix}")
         self.log_router = LogRouter(
-            rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
+            rproc, self.loop, KeyPartitionMap(list(splits), remote_tags),
+            replacement=replacement,
         )
         self.log_router.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
-        self.controller.stream_consumers[ROUTER_TAG] = self.log_router
+        if register:
+            self.controller.stream_consumers[ROUTER_TAG] = self.log_router
 
     def restart_log_router(self) -> None:
         """Replace a dead log router with a fresh one on a new process —
@@ -524,25 +629,13 @@ class RecoverableCluster:
         The new router resumes the ROUTER tag from the TLogs' retained
         backlog (nothing was popped while the old one was dark) and the
         remote replicas re-point at its streams."""
-        from ..roles.logrouter import ROUTER_TAG, LogRouter
-        from ..roles.proxy import KeyPartitionMap
+        from ..roles.logrouter import ROUTER_TAG
         from ..rpc.stream import RequestStreamRef as _Ref
 
         if self.log_router is not None:
             self.log_router.stop()
-        splits = self._initial_storage_splits
-        remote_tags = [[s.tag] for s in self.remote_storage] or [
-            [f"remote-{i}-r0"] for i in range(len(splits) + 1)
-        ]
-        rproc = self.net.create_process(
-            f"log-router-{self.rng.random_unique_id()[:4]}"
-        )
-        self.log_router = LogRouter(
-            rproc, self.loop, KeyPartitionMap(list(splits), remote_tags)
-        )
-        self.log_router.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
+        self._build_log_router(replacement=True)
         cc = self.controller
-        cc.stream_consumers[ROUTER_TAG] = self.log_router
         gen = cc.generation
         if gen is not None:
             cc._wire_stream_consumer(gen, ROUTER_TAG)
@@ -552,28 +645,196 @@ class RecoverableCluster:
                 _Ref(self.net, ss.process, self.log_router.pop_stream.endpoint),
             )
 
-    def _make_remote_storage(self, n_storage_shards: int, make_store) -> None:
-        from ..rpc.stream import RequestStreamRef as _Ref
+    def restart_remote_region(self) -> None:
+        """Reboot a power-killed remote region from its disks (the
+        KillRegion remote-kill recovery path): every dead remote replica is
+        rebuilt from its store file's durable prefix — the power kill
+        already dropped the un-fsynced tail — and a replacement router
+        resumes the ROUTER tag from the primary TLogs' retained backlog
+        (the router pops only at the remote-durable floor, so nothing a
+        dead replica had not made durable was ever released).  Zero
+        committed-data loss is structural: durable prefix + retained relay
+        covers every acked commit."""
+        from ..runtime.coverage import testcov
 
-        self.remote_storage: list[StorageServer] = []
-        for i in range(n_storage_shards):
-            p = self.net.create_process(f"remote-storage-{i}")
-            store = make_store(f"remote{i}.kv", p)
+        assert not self._region_promoted, (
+            "a promoted region's replicas heal through data distribution"
+        )
+        for i, old in enumerate(self.remote_storage):
+            if old.process.alive:
+                continue
+            old.stop()
+            p = self.net.create_process(
+                f"remote-storage-{i}-{self.rng.random_unique_id()[:4]}"
+            )
+            store = self._make_store_recover(f"remote{i}.kv", p)
             ss = StorageServer(
                 p, self.loop, self.knobs,
-                tlog_peek_ref=_Ref(self.net, p, self.log_router.peek_stream.endpoint),
-                tlog_pop_ref=_Ref(self.net, p, self.log_router.pop_stream.endpoint),
-                tag=f"remote-{i}-r0",
-                store=store,
+                tlog_peek_ref=None, tlog_pop_ref=None,
+                tag=old.tag, store=store,
                 start_version=(
                     store.meta.get("durable_version", 0)
                     if self.fs is not None else 0
                 ),
             )
             ss.start_metrics(self.trace, self.knobs.METRICS_INTERVAL)
-            self.remote_storage.append(ss)
+            self.remote_storage[i] = ss
+        self.controller.conf_fallback_servers = self.remote_storage[-1:]
+        # the router last: its remote map and the replicas' stream refs
+        # must see the REBUILT set
+        self.restart_log_router()
+        testcov("region.remote_rebuilt")
+        self.trace.trace(
+            "RemoteRegionRestarted",
+            Tags=[s.tag for s in self.remote_storage],
+        )
+
+    def _make_store_recover(self, fname: str, proc):
+        """A store over `fname`, recovering the durable contents if the
+        file exists (the region-reboot twin of __init__'s make_store)."""
+        if self.fs is None:
+            return MemoryKeyValueStore()
+        if self.storage_engine == "ssd":
+            from ..storage.btree import BTreeKeyValueStore as cls_
+
+            probe = fname + ".hdr"
+        else:
+            from ..storage.kvstore import DurableMemoryKeyValueStore as cls_
+
+            probe = fname
+        if self.fs.exists(probe):
+            return cls_.recover(self.fs, fname, proc)
+        return cls_(self.fs, fname, proc)
+
+    async def _enable_remote_region_online(self) -> None:
+        """usable_regions 1→2 on a LIVE cluster: build the relay plane,
+        wire it through enable_stream_consumer — the drain barrier that
+        tags every future commit with the router tag, sets the router's
+        TLog source, and hands back the boundary version — then
+        snapshot-fetch everything BELOW the boundary into the new
+        replicas from the primary teams (fetchKeys buffers tagged
+        mutations that race the copy, exactly like a dd heal).  Only once
+        the copies land is the region a failover candidate
+        (`_remote_history_complete`)."""
+        from ..roles.logrouter import ROUTER_TAG
+        from ..runtime.combinators import wait_all
+        from ..rpc.stream import RequestStreamRef as _Ref
+
+        cc = self.controller
+        if not self.remote_storage:
+            self._remote_history_complete = False
+            applied = cc.region_config
+            self._prepare_remote_region(restart=False, register_router=False)
+            # _prepare's config assignment is for the BIRTH path; here the
+            # APPLIED config is recorded by the region step only once the
+            # whole enable (fetch included) succeeds — otherwise a failed
+            # enable would read as no-drift and never be retried
+            cc.region_config = applied
+            while True:
+                vm = await cc.enable_stream_consumer(
+                    ROUTER_TAG, self.log_router
+                )
+                if vm is not None:
+                    break
+                await self.loop.delay(0.1, TaskPriority.COORDINATION)
+        else:
+            # resuming a half-enabled region (the history fetch failed —
+            # e.g. a source died mid-copy): the relay is already live, so
+            # refetch below the CURRENT frontier; stream mutations racing
+            # the copy are buffered by fetchKeys as usual
+            vm = 0
+
+        def min_end(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            return min(a, b)
+
+        ib = [b""] + list(self._initial_storage_splits) + [None]
+        bounds = [b""] + list(cc.storage_splits) + [None]
+        futs = []
+        for i, ss in enumerate(self.remote_storage):
+            for j, team in enumerate(cc._storage_teams()):
+                lo = max(ib[i], bounds[j])
+                hi = min_end(ib[i + 1], bounds[j + 1])
+                if hi is not None and lo >= hi:
+                    continue
+                refs = [
+                    _Ref(self.net, ss.process, src.getkv_stream.endpoint)
+                    for src in team
+                ]
+                futs.append(ss.start_fetch(lo, hi, vm, refs))
+        # failures bubble to the region step: region_config keeps its
+        # applied value, so the next conf poll re-detects the drift and
+        # resumes HERE; the failover gate refuses until the copy lands
+        await wait_all(futs)
+        self._remote_history_complete = True
+        self.trace.trace(
+            "RemoteRegionEnabled", Boundary=vm,
+            Replicas=[s.tag for s in self.remote_storage],
+        )
+
+    async def _on_region_change(self, new, old) -> bool:
+        """The controller's region-configuration hook (one change, driven
+        by the conf watch's background region step): build the second
+        region online, run the configure-driven failover, or tear the
+        relay plane down.  Returns False when the change cannot apply yet
+        (the next conf poll retries)."""
+        from ..runtime.coverage import testcov
+
+        if new.usable_regions >= 2 and (
+            not self.remote_storage or not self._remote_history_complete
+        ):
+            await self._enable_remote_region_online()
+            testcov("region.enabled_online")
+        if new.primary == "remote" and not self._region_promoted:
+            if not self.remote_storage or not self._remote_history_complete:
+                # nothing to fail over to (yet): no remote plane, or an
+                # online enable whose history copy has not landed —
+                # promoting would serve a region missing committed data
+                return False
+            testcov("region.failover_configured")
+            if not await self.promote_remote_region():
+                return False
+        if (
+            new.usable_regions < 2
+            and new.primary == "primary"
+            and not self._region_promoted
+            and self.log_router is not None
+        ):
+            # drop the relay plane: the remote region leaves the
+            # durability story (configure usable_regions=1)
+            from ..roles.logrouter import ROUTER_TAG
+
+            await self.controller.disable_stream_consumer(ROUTER_TAG)
+            self.log_router.stop()
+            self.log_router = None
+            for ss in self.remote_storage:
+                ss.stop()
+            self.remote_storage = []
+            self.controller.conf_fallback_servers = []
+            testcov("region.disabled_online")
+        return True
 
     async def promote_remote_region(self) -> bool:
+        """Region failover, tracked: the body runs as a task `stop()` can
+        cancel — a mid-flight promotion's convergence wait must die with
+        the cluster, never keep rewiring a stopped topology.  The
+        cancellation propagates to the caller as ActorCancelled."""
+        t = self.loop.spawn(
+            self._promote_remote_region(), TaskPriority.COORDINATION,
+            "region-promote",
+        )
+        self._region_task = t
+        try:
+            return await t
+        except ActorCancelled:
+            raise  # a cancelled promotion is teardown — never report False
+        finally:
+            self._region_task = None
+
+    async def _promote_remote_region(self) -> bool:
         """Region failover's write half: adopt the remote replicas as the
         PRIMARY storage set.  The keyServers map swaps to the remote tags
         at a drained boundary, the remote servers re-point their pulls from
@@ -584,6 +845,12 @@ class RecoverableCluster:
         swap is this runtime's equivalent serialization point."""
         cc = self.controller
         for ss in self.remote_storage:
+            # ping responder FIRST: the moment a replica joins cc.storage
+            # the dd heal loop starts pinging it, and an unregistered pong
+            # endpoint reads as a dead server — dd would "heal" the very
+            # replica being promoted, stopping the only holder of the
+            # not-yet-durable window (found by KillRegionRestart seed 7711)
+            self.dd._watch(ss)
             cc._tag_to_ss[ss.tag] = ss
             if ss not in cc.storage:
                 cc.storage.append(ss)
@@ -610,16 +877,46 @@ class RecoverableCluster:
                 _Ref(self.net, ss.process, tlog.peek_stream.endpoint),
                 _Ref(self.net, ss.process, tlog.pop_stream.endpoint),
             )
-            self.dd._watch(ss)  # healing now covers the promoted servers
-        # the relay is no longer between the remotes and the write path
-        await cc.disable_stream_consumer(ROUTER_TAG)
-        self.log_router.stop()
-        self.log_router = None
+        # the router tag may only be RELEASED once every promoted replica
+        # has made the pre-boundary stream durable: until then the retained
+        # backlog is the only TLog copy a reboot could re-serve them (the
+        # MVCC window holds their disks back from vm for seconds) — a
+        # background retirement watches the durability floor; meanwhile the
+        # tag stays registered, so recoveries keep re-seeding it and a
+        # power kill lands on the promoted-reboot remap path instead of on
+        # lost data (found by KillRegionRestart seed 7711: acked commits
+        # died with an eagerly-popped router tag)
+        self._router_retire_task = self.loop.spawn(
+            self._retire_router(vm), TaskPriority.COORDINATION,
+            "region-router-retire",
+        )
         for view in cc.views:
             if getattr(view, "pinned_smap", None) is None:
                 cc._fill_view(view)
+        self._region_promoted = True
         self.trace.trace("RegionPromoted", Tags=[s.tag for s in self.remote_storage])
         return True
+
+    async def _retire_router(self, vm) -> None:
+        """Drop the router plane once the promoted replicas' DURABLE
+        versions pass the promotion boundary (read through the controller
+        map: data distribution may heal a promoted replica mid-wait)."""
+        from ..roles.logrouter import ROUTER_TAG
+        from ..runtime.coverage import testcov
+
+        cc = self.controller
+        tags = [ss.tag for ss in self.remote_storage]
+        while True:
+            servers = [cc._tag_to_ss.get(t) for t in tags]
+            if all(s is not None and s.durable_version >= vm for s in servers):
+                break
+            await self.loop.delay(0.25, TaskPriority.COORDINATION)
+        await cc.disable_stream_consumer(ROUTER_TAG)
+        if self.log_router is not None:
+            self.log_router.stop()
+            self.log_router = None
+        testcov("region.router_retired")
+        self.trace.trace("RegionRouterRetired", Boundary=vm)
 
     def remote_database(self) -> Database:
         """A client view whose READS route to the remote region's replicas
@@ -766,6 +1063,13 @@ class RecoverableCluster:
         if getattr(self, "_stopped", False):
             return
         self._stopped = True
+        if getattr(self, "_region_task", None) is not None:
+            # a mid-flight promote_remote_region() dies with the cluster:
+            # its convergence wait must not keep running against stopped
+            # roles (the ActorCancelled propagates to whoever awaited it)
+            self._region_task.cancel()
+        if getattr(self, "_router_retire_task", None) is not None:
+            self._router_retire_task.cancel()
         self._wire_metrics_task.cancel()
         for t in self._client_metric_tasks:
             t.cancel()

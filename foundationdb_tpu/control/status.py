@@ -303,6 +303,28 @@ def cluster_status(cluster) -> dict[str, Any]:
             ),
         }
         doc["cluster"]["stream_consumers"] = sorted(controller.stream_consumers)
+        rc = getattr(controller, "region_config", None)
+        lr = getattr(cluster, "log_router", None)
+        if rc is not None and (
+            rc.usable_regions >= 2 or getattr(cluster, "remote_storage", [])
+        ):
+            # the region plane (control/region.py): applied configuration +
+            # relay health — the operator's failover dashboard
+            doc["cluster"]["regions"] = {
+                "usable_regions": rc.usable_regions,
+                "satellite": rc.satellite,
+                "primary": rc.primary,
+                "promoted": bool(getattr(cluster, "_region_promoted", False)),
+                "remote_replicas": len(getattr(cluster, "remote_storage", [])),
+                "router": (
+                    {
+                        "version": lr.version.get(),
+                        "known_committed": lr.known_committed,
+                        "queue_depth": sum(len(q) for q in lr._tags.values()),
+                    }
+                    if lr is not None else None
+                ),
+            }
     if rk is not None:
         doc["ratekeeper"] = rk.status()
     if loop.profile:
@@ -358,6 +380,14 @@ STATUS_SCHEMA: dict = {
             "devices?": dict, "device_transitions?": int,
         },
         "stream_consumers?": list,
+        "regions?": {
+            "usable_regions": int,
+            "satellite": str,
+            "primary": str,
+            "promoted": bool,
+            "remote_replicas": int,
+            "router": (dict, type(None)),
+        },
     },
     "proxy": {
         "committed_version": int,

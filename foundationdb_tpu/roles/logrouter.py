@@ -42,11 +42,18 @@ class LogRouter:
     WLT_POP = "wlt:router_pop"
 
     def __init__(self, process: SimProcess, loop: EventLoop,
-                 remote_map: KeyPartitionMap, start_version: Version = 0) -> None:
+                 remote_map: KeyPartitionMap, start_version: Version = 0,
+                 replacement: bool = False) -> None:
         self.process = process
         self.loop = loop
         self.remote_map = remote_map  # key partition -> remote TEAM of tags
         self.tag = ROUTER_TAG
+        # a replacement router (restart_log_router / a region reboot)
+        # resumes the tag from the primary TLogs' RETAINED backlog — its
+        # first successful re-pull is the observable the KillRegion
+        # campaigns require coverage of
+        self._replacement = replacement
+        self._repull_marked = False
         self.tlog = None
         self.tlog_pops: list = []
         self._fetched = start_version
@@ -87,6 +94,11 @@ class LogRouter:
                 await self.loop.delay(0.1, TaskPriority.STORAGE_SERVER)
                 continue
             self.known_committed = max(self.known_committed, reply.known_committed)
+            if self._replacement and reply.entries and not self._repull_marked:
+                from ..runtime.coverage import testcov
+
+                self._repull_marked = True
+                testcov("region.router_repull")
             for version, muts in reply.entries:
                 if version <= self._fetched:
                     continue

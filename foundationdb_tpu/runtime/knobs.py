@@ -47,6 +47,10 @@ ENV_KNOBS: dict[str, str] = {
                           "passes none: SaveAndKill part-1 saves land there "
                           "and run_restarting_pair uses it instead of a temp "
                           "dir (workloads/spec.py)",
+    "FDBTPU_BLOB_URL": "default backup-container URL for backup_container() "
+                       "when the caller names none: file://<prefix>, "
+                       "blob://<name>, or http://host:port/<name> against a "
+                       "BlobStoreServer (client/backup.py)",
 }
 
 
@@ -162,6 +166,17 @@ class CoreKnobs(Knobs):
         self.init("DEVICE_RETRY_BACKOFF", 0.05 if r is None else 0.02 + r.random() * 0.1)
         self.init("DEVICE_MAX_BACKOFF", 5.0)
         self.init("DEVICE_REPROBE_INTERVAL", 5.0 if r is None else 1.0 + r.random() * 8.0)
+
+        # blob store (storage/blobstore.py): the retrying client's budget.
+        # Every operation against the object store retries transient and
+        # checksum failures BLOB_RETRY_LIMIT times with exponential backoff
+        # from BLOB_BACKOFF_S doubling to BLOB_MAX_BACKOFF_S (each retry
+        # traces a SEV_WARN BlobRequestRetried); BLOB_PART_BYTES is the
+        # multipart chunk size uploads are split into.
+        self.init("BLOB_RETRY_LIMIT", 6)
+        self.init("BLOB_BACKOFF_S", 0.02 if r is None else 0.01 + r.random() * 0.05)
+        self.init("BLOB_MAX_BACKOFF_S", 1.0)
+        self.init("BLOB_PART_BYTES", 1 << 15)
 
         # trace plane (docs/OBSERVABILITY.md "Distributed tracing"): the
         # TraceEvent file/ring discipline.  TRACE_SEVERITY drops events

@@ -39,6 +39,9 @@ WARN_EVENT_TYPES = frozenset({
                                  # SLOW_TASK_THRESHOLD host wall seconds
     "SoakSeedFailed",            # tools/soak.py: a campaign seed's verdict
                                  # with the failure, for triage scrapes
+    "BlobRequestRetried",        # storage/blobstore.py: one blob-store
+                                 # retry (backoff in flight); soak triage
+                                 # summarizes retry storms per seed
 })
 
 

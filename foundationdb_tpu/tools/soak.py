@@ -263,9 +263,19 @@ def triage_seed(events: list[dict[str, Any]], spec_path: str,
         "slow_task_count": sum(
             1 for e in events if e.get("Type") == "SlowTask"
         ),
+        "blob_retry_count": blob_retry_count(events),
         "slowest_transaction": slow[0] if slow else None,
         "repro": repro_command(spec_path, seed),
     }
+
+
+def blob_retry_count(events: list[dict[str, Any]]) -> int:
+    """SEV_WARN BlobRequestRetried events in a seed's trace stream — the
+    blob-store backoff in flight.  A storm here (far above the forced
+    fault budget) means the object store was effectively unreachable for
+    stretches of the run, which reshapes backup timing even on passing
+    seeds, so the campaign summarizes it per seed."""
+    return sum(1 for e in events if e.get("Type") == "BlobRequestRetried")
 
 
 # ---------------------------------------------------------------------------
@@ -376,6 +386,9 @@ def run_campaign(spec_path: str, seeds: list[int], outdir: str,
         events = trace_tool.load_events([adir]) if os.path.isdir(adir) else []
         per_seed_census[seed] = census_from_events(events)
         r = results[seed]
+        n_retries = blob_retry_count(events)
+        if n_retries:
+            r["blob_retries"] = n_retries  # per-seed storm summary
         if r["verdict"] != "pass":
             r["triage"] = triage_seed(events, spec_path, seed)
         elif not keep_traces:
@@ -475,6 +488,17 @@ def render_markdown(report: dict) -> str:
     ]
     for name, m in sorted(merged["testcov"].items()):
         lines.append(f"| {name} | {m['hit_seeds']} | {m['hits']} |")
+    storms = [r for r in report["per_seed"] if r.get("blob_retries")]
+    if storms:
+        lines += [
+            "",
+            "## Blob retry storms (SEV_WARN `BlobRequestRetried` per seed)",
+            "",
+            "| seed | retries |",
+            "|---|---|",
+        ]
+        for r in sorted(storms, key=lambda r: -r["blob_retries"]):
+            lines.append(f"| {r['seed']} | {r['blob_retries']} |")
     failing = [r for r in report["per_seed"] if r["verdict"] != "pass"]
     if failing:
         lines += ["", "## Triage"]
@@ -488,7 +512,8 @@ def render_markdown(report: dict) -> str:
                 f"- repro: `{t.get('repro', repro_command(report['spec'], r['seed']))}`",
                 f"- SEV_ERROR events: {t.get('error_count', 0)}, "
                 f"SEV_WARN+: {t.get('warn_count', 0)}, "
-                f"SlowTask: {t.get('slow_task_count', 0)}",
+                f"SlowTask: {t.get('slow_task_count', 0)}, "
+                f"blob retries: {t.get('blob_retry_count', 0)}",
             ]
             for ev in t.get("first_events", []):
                 lines.append(

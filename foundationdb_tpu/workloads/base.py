@@ -41,6 +41,15 @@ class Workload:
         the wrong invariant against the saved disks."""
         return {}
 
+    def load_restart_manifest(self, manifest: dict) -> None:
+        """Part-2 hook: run_spec hands each workload the restart manifest
+        (including `part1_metrics`, what part 1's workloads had actually
+        achieved at the kill) before the run.  A verify-mode workload can
+        anchor its checks to part 1's recorded progress — e.g. KillRegion
+        requires the rebooted watermark to cover every commit part 1 had
+        ACKNOWLEDGED, instead of guessing how far part 1 got before the
+        buggify-jittered power kill landed."""
+
 
 def run_workloads(
     cluster: SimCluster, workloads: list[Workload], deadline: float = 300.0

@@ -57,6 +57,7 @@ import re
 from .attrition import AttritionWorkload
 from .bank import BankWorkload
 from .base import run_workloads
+from .blob_backup import BlobBackupWorkload
 from .configure_db import ConfigureDatabaseWorkload
 from .conflict_range import ConflictRangeWorkload
 from .consistency import ConsistencyCheckWorkload
@@ -64,6 +65,7 @@ from .cycle import CycleWorkload
 from .device_fault import DeviceFaultWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
+from .kill_region import KillRegionWorkload
 from .readwrite import ReadWriteWorkload
 from .rollback import RollbackWorkload
 from .save_and_kill import RestartKill, SaveAndKillWorkload, invariant_states
@@ -90,6 +92,8 @@ WORKLOAD_FACTORY = {
     "SelectorOracle": SelectorOracleWorkload,
     "SaveAndKill": SaveAndKillWorkload,
     "Rollback": RollbackWorkload,
+    "KillRegion": KillRegionWorkload,
+    "BlobBackup": BlobBackupWorkload,
 }
 
 # spec key -> RecoverableCluster kwarg
@@ -106,6 +110,9 @@ _CLUSTER_KEYS = {
     "engine": ("storage_engine", str),
     "redundancy": ("redundancy", str),
     "chaos": ("chaos", "bool"),
+    # region-configuration bootstrap (control/region.py): 2 builds the
+    # remote plane (log router + remote replicas) from birth
+    "usableRegions": ("usable_regions", int),
     # fraction of transactions given a pipeline-timeline debug ID — the
     # per-seed trace-artifact hook (soak campaigns override per run)
     "sampleRate": ("debug_sample_rate", float),
@@ -122,6 +129,9 @@ _CLUSTER_KEYS = {
 _IMAGE_KEYS = (
     "seed", "n_storage_shards", "storage_replication", "n_tlogs",
     "n_machines", "n_dcs", "storage_engine", "redundancy",
+    # shapes the disks (remote<i>.kv files + which serving set the saved
+    # keyServers map can name), so a pair must agree on it
+    "usable_regions",
 )
 
 # spec `backend=` values -> conflict-backend factories
@@ -360,6 +370,8 @@ def run_spec(text: str, deadline: float = 900.0, *, seed: int | None = None,
         if restart_manifest is not None:
             _check_restart_states(workloads,
                                   restart_manifest.get("workloads", {}))
+            for w in workloads:
+                w.load_restart_manifest(restart_manifest)
             testcov("restart.booted_from_image")
             c.trace.trace("RestartFromImage", Image=restart_image,
                           Seed=cluster_kwargs.get("seed", 0),

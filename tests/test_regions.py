@@ -1,0 +1,583 @@
+"""Region configuration + configure-driven failover + KillRegion
+(control/region.py, fdbrpc/simulator.h:285 usableRegions analog,
+fdbserver/workloads/KillRegion.actor.cpp): the region plane as committed
+`\\xff/conf/` state, the satellite-style recovery requirement on the
+log-router tag, whole-region kills with zero committed-data loss, and the
+promoted/un-promoted reboot paths."""
+
+import pytest
+
+from foundationdb_tpu.client import management as mgmt
+from foundationdb_tpu.control.logsystem import region_required_tags
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.control.region import (
+    PRIMARY_KEY,
+    SATELLITE_KEY,
+    USABLE_REGIONS_KEY,
+    RegionConfiguration,
+    parse_region_rows,
+)
+from foundationdb_tpu.roles.logrouter import ROUTER_TAG
+from foundationdb_tpu.runtime.core import ActorCancelled
+from foundationdb_tpu.workloads.base import run_workloads
+from foundationdb_tpu.workloads.kill_region import KillRegionWorkload
+
+
+def _run(c, coro, deadline=900.0):
+    return c.run_until(c.loop.spawn(coro), deadline)
+
+
+# ---------------------------------------------------------------------------
+# the configuration object + codec
+
+
+def test_region_configuration_validate_and_rows():
+    cfg = RegionConfiguration(usable_regions=2, primary="remote")
+    cfg.validate()
+    assert cfg.router_tag_required
+    assert not RegionConfiguration().router_tag_required
+    assert not RegionConfiguration(usable_regions=2,
+                                   satellite="none").router_tag_required
+    with pytest.raises(ValueError, match="usable_regions"):
+        RegionConfiguration(usable_regions=3).validate()
+    with pytest.raises(ValueError, match="satellite"):
+        RegionConfiguration(satellite="maybe").validate()
+    with pytest.raises(ValueError, match="primary"):
+        RegionConfiguration(primary="mars").validate()
+    # rows -> parse roundtrip
+    assert parse_region_rows(cfg.rows()) == cfg
+
+
+def test_parse_region_rows_absent_and_malformed():
+    assert parse_region_rows([(b"\xff/conf/n_tlogs", b"2")]) is None
+    # malformed values fall back field-by-field, never raise
+    cfg = parse_region_rows([
+        (USABLE_REGIONS_KEY, b"banana"),
+        (SATELLITE_KEY, b"\xff\xfe"),
+        (PRIMARY_KEY, b"remote"),
+    ])
+    assert cfg == RegionConfiguration(primary="remote")
+    base = RegionConfiguration(usable_regions=2, satellite="none")
+    cfg = parse_region_rows([(PRIMARY_KEY, b"remote")], base=base)
+    assert cfg.usable_regions == 2 and cfg.satellite == "none"
+
+
+def test_region_required_tags():
+    consumers = {ROUTER_TAG: object()}
+    tags = ["ss-0-r0", "ss-0-r1"]
+    assert region_required_tags(tags, RegionConfiguration(), consumers) == tags
+    got = region_required_tags(
+        tags, RegionConfiguration(usable_regions=2), consumers
+    )
+    assert got == tags + [ROUTER_TAG]
+    # no registered router (already promoted): nothing to require
+    assert region_required_tags(
+        tags, RegionConfiguration(usable_regions=2), {}
+    ) == tags
+    # satellite=none opts the router tag out of the requirement
+    assert region_required_tags(
+        tags, RegionConfiguration(usable_regions=2, satellite="none"),
+        consumers,
+    ) == tags
+
+
+def test_configure_regions_verbs():
+    c = RecoverableCluster(seed=7401, usable_regions=2)
+    db = c.database()
+
+    async def main():
+        assert await mgmt.get_region_configuration(db) is None
+        await mgmt.configure_regions(db, usable_regions=2,
+                                     satellite="required")
+        cfg = await mgmt.get_region_configuration(db)
+        assert cfg == RegionConfiguration(usable_regions=2)
+        with pytest.raises(ValueError):
+            await mgmt.configure_regions(db, primary="mars")
+        return True
+
+    assert _run(c, main())
+    # the conf watch applied the (non-failover) config
+    for _ in range(40):
+        if c.controller.region_config == RegionConfiguration(usable_regions=2):
+            break
+        _run(c, c.loop.delay(0.25))
+    assert c.controller.region_config.usable_regions == 2
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# topology bootstrap + configure-driven failover
+
+
+def test_usable_regions_2_builds_remote_plane():
+    c = RecoverableCluster(seed=7402, n_storage_shards=2, usable_regions=2)
+    assert c.log_router is not None
+    assert len(c.remote_storage) == 2
+    assert c.controller.region_config.usable_regions == 2
+    assert ROUTER_TAG in c.controller.stream_consumers
+    assert c.controller.conf_fallback_servers == c.remote_storage[-1:]
+    c.stop()
+
+
+def test_online_enable_copies_history_then_failover_serves_everything():
+    """usable_regions 1→2 on a LIVE single-region cluster with existing
+    data: the conf watch builds the relay plane through the
+    enable_stream_consumer drain barrier (commits tagged from the
+    boundary on) AND snapshot-fetches the pre-boundary history into the
+    new replicas — so a later failover serves EVERY committed key, not
+    just post-enable traffic."""
+    c = RecoverableCluster(seed=7410, n_storage_shards=2,
+                          storage_replication=2)  # single-region birth
+    assert not c.remote_storage
+    db = c.database()
+
+    async def main():
+        for i in range(15):  # pre-enable history
+            async def fn(tr, i=i):
+                tr.set(b"oe%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        await mgmt.configure_regions(db, usable_regions=2)
+        for _ in range(2000):
+            if c.remote_storage and c._remote_history_complete:
+                break
+            await c.loop.delay(0.05)
+        assert c.remote_storage and c._remote_history_complete
+        assert c.log_router is not None
+        for i in range(15, 25):  # post-enable traffic rides the relay
+            async def fn(tr, i=i):
+                tr.set(b"oe%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fv)
+        for _ in range(2000):
+            if all(s.version.get() >= v[0] for s in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        # the configure-driven failover must now serve the FULL history
+        for ss in c.storage:
+            ss.process.kill()
+        await mgmt.configure_regions(db, primary="remote")
+        for _ in range(6000):
+            if c._region_promoted:
+                break
+            await c.loop.delay(0.05)
+        assert c._region_promoted
+
+        async def rd(tr):
+            return await tr.get_range(b"oe", b"of", limit=1000)
+
+        rows = dict(await db.run(rd))
+        assert rows == {b"oe%03d" % i: b"v%d" % i for i in range(25)}
+        return True
+
+    assert _run(c, main())
+    from foundationdb_tpu.runtime import coverage
+
+    assert coverage.census().get("region.enabled_online", 0) >= 1
+    c.stop()
+
+
+def test_online_enable_failed_fetch_resumes(monkeypatch):
+    """Review regression: a history fetch that fails mid-enable must be
+    RESUMED by a later conf poll (the applied region_config is recorded
+    only on full success, so the desired-vs-applied drift persists), and
+    the failover gate refuses until the copy lands."""
+    from foundationdb_tpu.roles.storage import StorageServer
+    from foundationdb_tpu.runtime.core import TimedOut
+
+    c = RecoverableCluster(seed=7411, n_storage_shards=2,
+                          storage_replication=2)
+    db = c.database()
+    orig = StorageServer.start_fetch
+    broke = {"n": 0}
+
+    def flaky(self, begin, end, boundary, sources):
+        if broke["n"] == 0:
+            broke["n"] += 1
+            raise TimedOut("injected mid-enable fetch failure")
+        return orig(self, begin, end, boundary, sources)
+
+    monkeypatch.setattr(StorageServer, "start_fetch", flaky)
+
+    async def main():
+        for i in range(8):
+            async def fn(tr, i=i):
+                tr.set(b"rf%02d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        await mgmt.configure_regions(db, usable_regions=2)
+        for _ in range(2000):
+            if c.remote_storage and c._remote_history_complete:
+                break
+            await c.loop.delay(0.05)
+        assert broke["n"] == 1, "the injected failure never fired"
+        assert c._remote_history_complete, "enable was never resumed"
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fv)
+        for _ in range(2000):
+            if all(s.version.get() >= v[0] for s in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        rdb = c.remote_database()
+
+        async def rd(tr):
+            return await tr.get_range(b"rf", b"rg", limit=100)
+
+        rows = dict(await rdb.run(rd))
+        assert rows == {b"rf%02d" % i: b"v%d" % i for i in range(8)}
+        return True
+
+    assert _run(c, main())
+    c.stop()
+
+
+def test_torn_region_row_holds_applied_config():
+    """Review regression: a malformed region row must hold the APPLIED
+    configuration (parse base), never decay to the defaults — a decayed
+    usable_regions=1 would read as a legitimate request to dismantle the
+    remote durability plane."""
+    c = RecoverableCluster(seed=7412, n_storage_shards=2, usable_regions=2)
+    db = c.database()
+
+    async def main():
+        await mgmt.configure_regions(db, usable_regions=2)
+        for _ in range(100):
+            if c.controller.region_config.usable_regions == 2:
+                break
+            await c.loop.delay(0.25)
+
+        async def torn(tr):
+            tr.set(USABLE_REGIONS_KEY, b"banana")
+
+        await db.run(torn)
+        await c.loop.delay(5.0)  # several conf polls over the torn row
+        assert c.controller.region_config.usable_regions == 2
+        assert c.log_router is not None and c.remote_storage
+        return True
+
+    assert _run(c, main())
+    c.stop()
+
+
+def test_configure_driven_failover_with_dead_primary_region():
+    """The KillRegion.actor.cpp contract in miniature: every primary
+    storage replica dies, the failover is COMMITTED as configuration
+    (readable only through the surviving remote replica), the controller
+    promotes, and writes+reads flow through the former remote region."""
+    c = RecoverableCluster(seed=7403, n_storage_shards=2,
+                          storage_replication=2, usable_regions=2)
+    db = c.database()
+
+    async def main():
+        for i in range(20):
+            async def fn(tr, i=i):
+                tr.set(b"f%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fv)
+        for _ in range(600):
+            if all(s.version.get() >= v[0] for s in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        for ss in c.storage:
+            ss.process.kill()
+        await mgmt.configure_regions(db, primary="remote")
+        for _ in range(6000):
+            if c._region_promoted:
+                break
+            await c.loop.delay(0.05)
+        assert c._region_promoted, "configured failover never applied"
+        assert c.controller.region_config.primary == "remote"
+
+        async def fn2(tr):
+            tr.set(b"f999", b"post-failover")
+
+        await db.run(fn2)
+
+        async def rd(tr):
+            return await tr.get_range(b"f", b"g", limit=1000)
+
+        rows = await db.run(rd)
+        assert len(rows) == 21
+        # the router retires only once the promoted replicas are DURABLE
+        # past the boundary (their MVCC-window hold-back) — keep the
+        # version clock moving and wait it out
+        for i in range(120):
+            if c.log_router is None:
+                break
+
+            async def nudge(tr, i=i):
+                tr.set(b"f-nudge", b"%d" % i)
+
+            await db.run(nudge)
+            await c.loop.delay(0.5)
+        assert c.log_router is None  # the relay ended with the failover
+        return True
+
+    assert _run(c, main())
+    from foundationdb_tpu.runtime import coverage
+
+    assert coverage.census().get("region.router_retired", 0) >= 1
+    c.stop()
+
+
+def test_stop_cancels_midflight_promotion():
+    """Satellite regression: stop() must cancel a mid-flight
+    promote_remote_region() cleanly — the promotion's convergence wait
+    dies with ActorCancelled instead of spinning against a stopped
+    cluster."""
+    c = RecoverableCluster(seed=7404, n_storage_shards=2, usable_regions=2)
+    db = c.database()
+
+    async def setup():
+        for i in range(5):
+            async def fn(tr, i=i):
+                tr.set(b"m%02d" % i, b"1")
+
+            await db.run(fn)
+        return True
+
+    assert _run(c, setup())
+    # kill the ROUTER so the remote replicas stop converging: the
+    # promotion's convergence wait can never complete
+    c.log_router.process.kill()
+    for ss in c.storage:
+        ss.process.kill()
+    t = c.loop.spawn(c.promote_remote_region())
+    c.loop.run_until(c.loop.delay(2.0))
+    assert not t.done(), "promotion should be stuck on convergence"
+    assert c._region_task is not None
+    c.stop()
+    c.loop.run_until(c.loop.delay(0.5))
+    assert t.done()
+    assert isinstance(t.exception(), ActorCancelled)
+
+
+def test_restart_remote_region_repulls_retained_backlog():
+    """Remote-region power kill + reboot from its disks: the replacement
+    router re-pulls the retained TLog backlog and the rebuilt replicas
+    converge exactly (zero committed-data loss, structurally)."""
+    c = RecoverableCluster(seed=7405, n_storage_shards=2, usable_regions=2)
+    db = c.database()
+
+    async def main():
+        for i in range(15):
+            async def fn(tr, i=i):
+                tr.set(b"rr%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        # region power loss: router + every remote replica at once
+        c.log_router.process.kill()
+        for ss in c.remote_storage:
+            ss.process.kill()
+        for i in range(15, 30):
+            async def fn(tr, i=i):
+                tr.set(b"rr%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        c.restart_remote_region()
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fv)
+        for _ in range(2000):
+            if all(s.version.get() >= v[0] for s in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        rdb = c.remote_database()
+
+        async def rd(tr):
+            return await tr.get_range(b"rr", b"rs", limit=1000)
+
+        rows = await rdb.run(rd)
+        assert len(rows) == 30
+        assert all(v == b"v%d" % i for i, (_k, v) in enumerate(rows))
+        return True
+
+    assert _run(c, main())
+    from foundationdb_tpu.runtime import coverage
+
+    assert coverage.census().get("region.router_repull", 0) >= 1
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# reboot-from-disk paths
+
+
+def test_promoted_reboot_serves_from_former_remote():
+    """After a completed failover, a whole-sim power kill + reboot must
+    resolve the promoted keyServers map (remote tags) and serve every
+    acked commit through the former remote region."""
+    c = RecoverableCluster(seed=7406, n_storage_shards=2,
+                          storage_replication=2, usable_regions=2)
+    w = KillRegionWorkload(keys=24, burst=6)
+    run_workloads(c, [w], deadline=900)
+    assert c._region_promoted
+    fs = c.power_off()
+
+    c2 = RecoverableCluster(seed=7406, n_storage_shards=2,
+                           storage_replication=2, usable_regions=2,
+                           fs=fs, restart=True)
+    assert c2._region_promoted
+    assert all(
+        t[0].startswith("remote-") for t in c2.controller.storage_teams_tags
+    )
+    w2 = KillRegionWorkload(keys=24, action="verify")
+    w2.run_setup = False
+    w2.part1_acked = w.acked  # what the manifest hook would carry
+    res = run_workloads(c2, [w2], deadline=900)
+    assert res["KillRegion"]["acked"] == 0
+    c2.stop()
+
+
+def test_promoted_reboot_inside_durability_window_loses_nothing():
+    """Regression (KillRegionRestart seed 7711): a whole-sim power kill
+    right after promotion — inside the promoted replicas' MVCC-window
+    durability lag — must lose NO acked commit.  The router tag is still
+    registered (retirement is durability-gated), so the reboot re-tags
+    its retained backlog into the remote tags' seeds
+    (region.router_seed_remap) and the replicas re-pull the stream they
+    owe their disks."""
+    c = RecoverableCluster(seed=7409, n_storage_shards=2,
+                          storage_replication=2, usable_regions=2)
+    db = c.database()
+
+    async def main():
+        for i in range(10):
+            async def fn(tr, i=i):
+                tr.set(b"w%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db.run(fv)
+        for _ in range(600):
+            if all(s.version.get() >= v[0] for s in c.remote_storage):
+                break
+            await c.loop.delay(0.05)
+        for ss in c.storage:
+            ss.process.kill()
+        assert await c.promote_remote_region()
+        # every acked commit above is still INSIDE the promoted replicas'
+        # durability window (their durable floor was ~0 at promotion):
+        # the retained router backlog is the only copy a promoted reboot
+        # can re-serve them
+        return True
+
+    assert _run(c, main())
+    assert c.log_router is not None, (
+        "retirement should still be pending inside the window"
+    )
+    fs = c.power_off()
+
+    c2 = RecoverableCluster(seed=7409, n_storage_shards=2,
+                           storage_replication=2, usable_regions=2,
+                           fs=fs, restart=True)
+    assert c2._region_promoted
+    db2 = c2.database()
+
+    async def read_all():
+        async def fn(tr):
+            return await tr.get_range(b"w", b"x", limit=1000)
+
+        return await db2.run(fn)
+
+    rows = dict(c2.run_until(c2.loop.spawn(read_all()), 900))
+    assert rows == {b"w%03d" % i: b"v%d" % i for i in range(10)}
+    from foundationdb_tpu.runtime import coverage
+
+    assert coverage.census().get("region.router_seed_remap", 0) >= 1
+    c2.stop()
+
+
+def test_unpromoted_reboot_keeps_router_plane():
+    """A two-region cluster rebooted BEFORE any failover rebuilds the
+    router plane and the remote replicas converge again (the router tag
+    rode the TLog seeds because the consumer is registered pre-boot)."""
+    c = RecoverableCluster(seed=7407, n_storage_shards=2, usable_regions=2)
+    db = c.database()
+
+    async def put(n):
+        for i in range(n):
+            async def fn(tr, i=i):
+                tr.set(b"u%03d" % i, b"v%d" % i)
+
+            await db.run(fn)
+        return True
+
+    assert _run(c, put(10))
+    fs = c.clean_shutdown()
+
+    c2 = RecoverableCluster(seed=7407, n_storage_shards=2, usable_regions=2,
+                           fs=fs, restart=True)
+    assert not c2._region_promoted
+    assert c2.log_router is not None
+    db2 = c2.database()
+
+    async def read_remote():
+        v = [0]
+
+        async def fv(tr):
+            v[0] = await tr.get_read_version()
+
+        await db2.run(fv)
+        for _ in range(2000):
+            if all(s.version.get() >= v[0] for s in c2.remote_storage):
+                break
+            await c2.loop.delay(0.05)
+        rdb = c2.remote_database()
+
+        async def rd(tr):
+            return await tr.get_range(b"u", b"v", limit=1000)
+
+        return await rdb.run(rd)
+
+    rows = c2.run_until(c2.loop.spawn(read_remote()), 900)
+    assert len(rows) == 10
+    c2.stop()
+
+
+# ---------------------------------------------------------------------------
+# the composed workload + restarting pair
+
+
+def test_kill_region_workload_standalone():
+    c = RecoverableCluster(seed=7408, n_storage_shards=2,
+                          storage_replication=2, usable_regions=2)
+    w = KillRegionWorkload(keys=30, burst=6)
+    res = run_workloads(c, [w], deadline=900)
+    assert res["KillRegion"]["acked"] == 30
+    assert res["KillRegion"]["kills"] == ["remote", "primary"]
+    c.stop()
+
+
+def test_kill_region_restart_pair_runs_green(tmp_path):
+    from foundationdb_tpu.workloads.spec import run_restarting_pair
+
+    res = run_restarting_pair(
+        "tests/specs/restarting/KillRegionRestart.txt",
+        image_dir=str(tmp_path / "image"),
+    )
+    assert res["part1"]["phase"] == 1
+    assert res["part2"]["KillRegion"] is not None
