@@ -263,6 +263,11 @@ class DRAgent:
         self.primary.controller._locked = b"dr-failover"
         deadline = self.loop.now() + timeout
         while True:
+            # the plane being drained IS this lock-armed generation; a
+            # recovery racing the drain re-arms the lock at birth (recovery
+            # reads controller._locked, set above), so no user commit can
+            # slip above `final` on either generation
+            # flowlint: ok stale-read-across-await (the drained plane is the lock-armed gen; a racing recovery re-arms the lock from _locked at birth)
             gen = self.primary.controller.generation
             if gen is not None and not self.primary.controller._recovering:
                 break

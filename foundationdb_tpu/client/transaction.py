@@ -39,6 +39,7 @@ from ..runtime.core import (
     BrokenPromise,
     DeterministicRandom,
     EventLoop,
+    Promise,
     TimedOut,
 )
 from ..runtime.trace import g_trace_batch
@@ -331,6 +332,11 @@ class Transaction:
     def __init__(self, db: Database) -> None:
         self.db = db
         self._read_version: Version | None = None
+        # single-flight GRV: concurrent first reads share ONE in-flight
+        # fetch (the reference caches a Future<Version>, not a value —
+        # NativeAPI's readVersion), or two racing reads could land in
+        # different proxy batches and observe DIFFERENT snapshots
+        self._grv_fetch = None
         self._mutations: list[Mutation] = []
         self._read_ranges: list[tuple[bytes, bytes]] = []
         self._write_ranges: list[tuple[bytes, bytes]] = []
@@ -375,6 +381,7 @@ class Transaction:
         """Clear all transaction state for a retry (fresh read version,
         empty mutation/conflict sets); the retry backoff is preserved."""
         self._read_version = None
+        self._grv_fetch = None
         self._mutations = []
         self._read_ranges = []
         self._write_ranges = []
@@ -465,20 +472,61 @@ class Transaction:
                 raise
 
     # -- read version -------------------------------------------------------
+    async def _fetch_read_version(self) -> Version:
+        g_trace_batch.add(
+            "NativeAPI.getConsistentReadVersion.Before", self.debug_id
+        )
+        reply = await self._reply_rerouted(
+            lambda: self.db._grv,
+            GetReadVersionRequest(debug_id=self.debug_id,
+                                  priority=self._priority),
+        )
+        g_trace_batch.add(
+            "NativeAPI.getConsistentReadVersion.After", self.debug_id
+        )
+        return reply.version
+
     async def get_read_version(self) -> Version:
-        if self._read_version is None:
-            g_trace_batch.add(
-                "NativeAPI.getConsistentReadVersion.Before", self.debug_id
-            )
-            reply = await self._reply_rerouted(
-                lambda: self.db._grv,
-                GetReadVersionRequest(debug_id=self.debug_id,
-                                      priority=self._priority),
-            )
-            self._read_version = reply.version
-            g_trace_batch.add(
-                "NativeAPI.getConsistentReadVersion.After", self.debug_id
-            )
+        # take ownership of the fetch BEFORE suspending: two reads racing
+        # the first GRV must share ONE request, or they can land in
+        # different proxy batches and pin DIFFERENT snapshots to one
+        # transaction (flowcheck check-then-act audit; regression-pinned by
+        # test_concurrent_first_reads_share_one_read_version).  The leader
+        # fetches inline (scheduling-identical to the sequential path);
+        # followers await its future.
+        while self._read_version is None:
+            # flowlint: ok stale-read-across-await (deliberate: the handler inspects the OUTCOME of the very future it awaited, not the current fetch)
+            fut = self._grv_fetch
+            if fut is not None:
+                # follower: share the in-flight fetch.  A LEADER failure is
+                # not ours to surface — re-lead a fresh fetch under our own
+                # deadline; only our own cancellation propagates.
+                try:
+                    await fut
+                except ActorCancelled:
+                    if fut.done() and fut.exception() is not None:
+                        continue  # the leader was cancelled: re-lead
+                    raise         # we ourselves were cancelled
+                except Exception:  # noqa: BLE001 — leader's fetch failed
+                    continue      # re-lead (shielded by the handler above)
+                continue  # leader filled _read_version
+            p = Promise()
+            self._grv_fetch = p.future
+            try:
+                v = await self._fetch_read_version()
+            except BaseException as e:
+                if self._grv_fetch is p.future:
+                    self._grv_fetch = None  # next caller leads a fresh fetch
+                p.fail(e)
+                raise
+            # publish only while still owning the fetch: a reset() during
+            # the RPC cleared the slot and a NEW leader may be in flight —
+            # stamping the pre-reset version here would pin the RETRIED
+            # transaction to a stale snapshot.  Disowned: wake followers
+            # and loop — they (and we) follow the new fetch.
+            if self._grv_fetch is p.future:
+                self._read_version = v
+            p.send(v)
         return self._read_version
 
     # -- reads --------------------------------------------------------------

@@ -371,6 +371,10 @@ class ClusterController:
         self._recovering = True
         try:
             self._set_state(RecoveryState.READING_CSTATE)
+            # deliberate pre-recovery snapshot: `old` IS the generation
+            # being deposed, and _recovering serializes recoveries — the
+            # one writer of self.generation is this function
+            # flowlint: ok stale-read-across-await (deliberate old-generation snapshot; _recovering serializes the only writer)
             old = self.generation
             prev_state = None
             if self.cstate is not None:
@@ -1475,6 +1479,7 @@ class ClusterController:
             ):
                 try:
                     if await self.on_coordinators_change(coord_n):
+                        # flowlint: ok check-then-act-across-await (single-writer: only this watch — one task — writes _coordinator_count)
                         self._coordinator_count = coord_n
                         testcov("management.coordinators_changed")
                         self.trace.trace(
@@ -1484,6 +1489,14 @@ class ClusterController:
                     raise  # cancelled mid-change: the watch is being torn down
                 except Exception as e:  # noqa: BLE001 — next poll retries
                     self.trace.trace("CoordinatorsChangeError", Error=repr(e))
+                # the hook awaited: a racing recovery may have swapped the
+                # generation while we were suspended, and every decision
+                # below (exclusion role check, desired-vs-actual counts)
+                # must compare against the LIVE pipeline — re-resolve
+                # (flowcheck stale-read audit)
+                gen = self.generation
+                if gen is None or self._recovering:
+                    continue
 
             # exclusion: targets hosting pipeline roles force a recovery
             # (recruitment avoids excluded machines/workers); storage drains
@@ -1641,7 +1654,9 @@ class ClusterController:
             if gen is None or self._recovering:
                 continue
             dead: list[str] = []
-            for p in gen.processes:
+            # snapshot: the ping awaits suspend, and the registry list must
+            # not be iterated live across scheduling points (flowcheck)
+            for p in list(gen.processes):
                 ref = RequestStreamRef(self.net, cc, Endpoint(p.address, "wlt:ping"))
                 try:
                     await ref.get_reply("ping", timeout=self.knobs.FAILURE_TIMEOUT)

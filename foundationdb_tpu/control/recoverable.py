@@ -455,6 +455,7 @@ class RecoverableCluster:
             return True
         state, _gen = await cc.cstate.read()
         self._coord_quorum_gen += 1
+        # flowlint: ok stale-read-across-await (g is THIS change's quorum number by construction; the conf watch runs one change at a time)
         g = self._coord_quorum_gen
         paths = [f"coord{i}-q{g}.reg" for i in range(n)]
         new_coords = [
@@ -863,15 +864,37 @@ class RecoverableCluster:
         # versions <= vm exist only in the router's relay; the router keeps
         # relaying until every promoted server is PAST the boundary, and
         # only then do they rejoin the primary TLogs (whose remote-tag
-        # entries start at vm) — no version gap at the handoff
-        for ss in self.remote_storage:
-            while ss.version.get() < vm:
+        # entries start at vm) — no version gap at the handoff.
+        # Each poll re-resolves the replica from the LIVE region set: the
+        # set can be rebuilt mid-wait (restart_remote_region replaces a
+        # power-killed replica's object in place), and a wait pinned to the
+        # pre-rebuild object would watch a dead server's frozen version
+        # forever (flowcheck mutate-while-iterating audit; regression-pinned
+        # by test_promotion_survives_remote_region_rebuild_mid_wait).
+        for tag in [ss.tag for ss in self.remote_storage]:
+            while True:
+                ss = next(
+                    (s for s in self.remote_storage if s.tag == tag), None
+                )
+                if ss is None or ss.version.get() >= vm:
+                    break
                 await self.loop.delay(0.05)
         gen = cc.generation
         from ..roles.logrouter import ROUTER_TAG
         from ..rpc.stream import RequestStreamRef as _Ref
 
-        for ss in self.remote_storage:
+        for ss in list(self.remote_storage):
+            # re-register through the controller map: a replica rebuilt
+            # during the convergence wait must displace its dead
+            # predecessor in cc.storage, or the heal loop and the router
+            # retirement keep watching the corpse
+            prev = cc._tag_to_ss.get(ss.tag)
+            if prev is not None and prev is not ss and prev in cc.storage:
+                cc.storage[cc.storage.index(prev)] = ss
+            elif ss not in cc.storage:
+                cc.storage.append(ss)
+            cc._tag_to_ss[ss.tag] = ss
+            self.dd._watch(ss)
             tlog = gen.tlogs[cc._tag_tlogs(ss.tag)[0]]
             ss.set_tlog_source(
                 _Ref(self.net, ss.process, tlog.peek_stream.endpoint),

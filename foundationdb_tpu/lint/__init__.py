@@ -21,6 +21,12 @@ Framework pieces:
                 a stale entry (file no longer trips the rule) ALSO fails —
                 the ratchet can only tighten
 
+Flow-sensitive layer (PR 12 "flowcheck" — docs/LINT.md "Interleaving
+hazards"): cfg.py builds per-function CFGs segmented at await points,
+dataflow.py runs reaching-definitions across segments plus the lazy
+cross-file effect/shared-state censuses, and rules_interleave.py hosts
+the five interleaving-hazard rules on top.
+
 Suppression syntax (a required reason keeps every escape hatch auditable):
 
   x = time.time()   # flowlint: ok wall-clock (probe budget is host wall)
@@ -263,11 +269,16 @@ def discover(paths: list[str], root: str) -> list[SourceFile]:
 
 
 def default_rules() -> list[Rule]:
-    from . import rules_async, rules_determinism, rules_registry
+    from . import rules_async, rules_determinism, rules_interleave, rules_registry
 
     return [
         rules_async.DroppedFutureRule(),
         rules_async.SwallowedCancelRule(),
+        rules_interleave.StaleReadAcrossAwaitRule(),
+        rules_interleave.CheckThenActAcrossAwaitRule(),
+        rules_interleave.EpochGuardMissingRule(),
+        rules_interleave.AwaitUnderLockRule(),
+        rules_interleave.MutateWhileIteratingRule(),
         rules_determinism.WallClockRule(),
         rules_determinism.UnseededRandomRule(),
         rules_determinism.ThreadingRule(),

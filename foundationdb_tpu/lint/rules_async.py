@@ -36,6 +36,10 @@ class DroppedFutureRule(Rule):
         #      also a sync def — a test's dropped `async def go` is dead too)
         #   3. `name()` where `name` was imported from a package module and
         #      is async-only package-wide
+        # plus, per function, names bound to an async callable through
+        # `functools.partial` / a trivial lambda / a method-alias
+        # assignment (the PR-9 blind spot: the effect census sees through
+        # those wrappers, so the dropped-future check must too).
         local_async = {
             n.name for n in ast.walk(sf.tree)
             if isinstance(n, ast.AsyncFunctionDef)
@@ -86,6 +90,99 @@ class DroppedFutureRule(Rule):
                         f"result of async method "
                         f"'self.{node.value.func.attr}' is dropped "
                         f"(coroutine constructed but never awaited/spawned)")
+
+        yield from self._check_wrapped(sf, bare_known)
+
+    def _check_wrapped(self, sf: SourceFile, bare_known: set[str]
+                       ) -> Iterable[Finding]:
+        """Partial/lambda/alias shapes, per enclosing function scope.  Each
+        function is scanned over its OWN body only (nested defs get their
+        own iteration), so one dropped call reports exactly once."""
+        from .dataflow import _async_binding_targets, _walk_no_defs_body
+
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls_async = self._enclosing_class_async(sf, fn)
+            wrapped = _async_binding_targets(fn, bare_known, cls_async)
+            for node in _walk_no_defs_body(fn):
+                # bare statement call of a wrapped async: the coroutine the
+                # wrapper builds is constructed and dropped
+                if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                    f = node.value.func
+                    if isinstance(f, ast.Name) and f.id in wrapped:
+                        yield self.finding(
+                            sf, node.lineno,
+                            f"result of async callable {f.id!r} (bound via "
+                            f"partial/lambda/alias) is dropped")
+                    # `functools.partial(async_f, ...)()` called and dropped
+                    # in one statement
+                    if isinstance(f, ast.Call) and self._is_partial_of(
+                        f, bare_known, cls_async
+                    ):
+                        yield self.finding(
+                            sf, node.lineno,
+                            "result of partial-wrapped async callable is "
+                            "dropped")
+                # spawn(partial(...)) / spawn(async_f): spawn needs a
+                # coroutine OBJECT; handing it the factory builds nothing —
+                # the role's background work silently never starts
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr == "spawn" and node.args:
+                    a = node.args[0]
+                    bad = None
+                    if isinstance(a, ast.Call) and self._is_partial_of(
+                        a, bare_known, cls_async
+                    ):
+                        bad = "a partial of an async callable"
+                    elif isinstance(a, ast.Name) and (
+                        a.id in bare_known or a.id in wrapped
+                    ):
+                        bad = f"the async callable {a.id!r} itself"
+                    elif isinstance(a, ast.Attribute) and isinstance(
+                        a.value, ast.Name
+                    ) and a.value.id == "self" and a.attr in cls_async:
+                        bad = f"the async method 'self.{a.attr}' itself"
+                    if bad is not None:
+                        yield self.finding(
+                            sf, node.lineno,
+                            f"spawn() received {bad}, not a coroutine — "
+                            f"call it: spawn(f(...))",
+                            hint="spawn takes the coroutine object; invoke "
+                                 "the callable (or the partial) first")
+
+    @staticmethod
+    def _is_partial_of(call: ast.Call, bare_known: set[str],
+                       cls_async: set[str]) -> bool:
+        f = call.func
+        is_partial = (
+            (isinstance(f, ast.Name) and f.id == "partial")
+            or (isinstance(f, ast.Attribute) and f.attr == "partial")
+        )
+        if not is_partial or not call.args:
+            return False
+        a0 = call.args[0]
+        if isinstance(a0, ast.Name) and a0.id in bare_known:
+            return True
+        return (
+            isinstance(a0, ast.Attribute)
+            and isinstance(a0.value, ast.Name)
+            and a0.value.id == "self"
+            and a0.attr in cls_async
+        )
+
+    @staticmethod
+    def _enclosing_class_async(sf: SourceFile, fn: ast.AST) -> set[str]:
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef) and any(
+                n is fn for n in ast.walk(cls)
+            ):
+                return {
+                    n.name for n in cls.body
+                    if isinstance(n, ast.AsyncFunctionDef)
+                }
+        return set()
 
 
 _BROAD = {"Exception", "BaseException"}

@@ -627,6 +627,13 @@ class CommitProxy:
             commit_lat.observe(t_reply - pc.arrive)
             if v == Verdict.COMMITTED:
                 self.c_committed.add(1)
+                # the database lock is admission control at batch ENTRY (the
+                # reference checks it once in commitBatch): a batch already
+                # past the gate when the lock lands commits — the lock
+                # linearizes AFTER in-flight batches, and dr.py's failover
+                # drains the plane before sampling `final` for exactly this
+                # reason
+                # flowlint: ok epoch-guard-missing (lock is checked at batch entry by design, like the reference commitBatch; in-flight batches serialize before the lock)
                 pc.reply_cb.reply(CommitReply(CommitResult.COMMITTED, version))
             elif v == Verdict.TOO_OLD:
                 pc.reply_cb.reply(CommitReply(CommitResult.TRANSACTION_TOO_OLD))

@@ -632,7 +632,7 @@ class StorageServer:
                     # version marker) BEFORE popping the TLog — the TLog is
                     # the only other copy of this data
                     await commit({"durable_version": flush_to})
-                self.durable_version = flush_to
+                self.durable_version = flush_to  # flowlint: ok check-then-act-across-await (single-writer: the one _durability task owns durable_version; freeze/unfreeze never runs two)
                 if self.tlog_pop is not None:
                     self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))
 

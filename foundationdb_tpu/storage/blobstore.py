@@ -550,10 +550,13 @@ class BlobStoreServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # take ownership before suspending: two racing stops must not both
+        # act on the shared handle across the await (flowcheck
+        # check-then-act discipline)
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     async def _handle(self, reader, writer) -> None:
         try:

@@ -1,11 +1,18 @@
 """flowlint CLI — the actor-discipline static analyzer (docs/LINT.md).
 
     python -m foundationdb_tpu.tools.flowlint foundationdb_tpu tests
+    python -m foundationdb_tpu.tools.flowlint --diff HEAD~1   # pre-commit
 
 Exit 0 only when every finding is fixed, suppressed with a reasoned
 `# flowlint: ok <rule> (...)`, or grandfathered in the committed baseline
 AND no baseline entry has gone stale (zero-or-fail in both directions —
 the ratchet can only tighten).  Also reachable as `cli lint`.
+
+`--diff <rev>` is the fast pre-commit spelling: the ANALYSIS still runs
+over the full tree (the cross-file censuses — effect summaries, shared
+state, registries — are only correct with everything in view), but only
+findings in files changed vs `rev` (plus untracked files) are REPORTED
+and gate the exit code.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from ..lint import (
@@ -28,6 +36,39 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".flowlint-baseline.json")
 
 
+def changed_files(rev: str, root: str) -> set[str] | None:
+    """Repo-relative (forward-slash) paths changed vs `rev`, plus
+    untracked files — the report filter behind `--diff`.  None when git
+    cannot answer (not a repo, bad rev): the caller falls back to a full
+    report rather than silently reporting nothing."""
+    try:
+        # --relative: findings carry --root-relative paths, and git must
+        # speak the same dialect even when root is a subdir of the repo —
+        # a toplevel-relative name would silently filter EVERYTHING out
+        diff = subprocess.run(
+            ["git", "diff", "--relative", "--name-only", rev, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    out = {
+        line.strip().replace(os.sep, "/")
+        for line in diff.stdout.splitlines() if line.strip()
+    }
+    if untracked.returncode == 0:
+        out |= {
+            line.strip().replace(os.sep, "/")
+            for line in untracked.stdout.splitlines() if line.strip()
+        }
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="flowlint", description="actor-discipline static analyzer")
@@ -41,6 +82,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="grandfather the current findings and exit 0")
     ap.add_argument("--json", dest="as_json", action="store_true",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--diff", metavar="REV", default=None,
+                    help="analyze the full tree but only REPORT (and gate "
+                         "on) findings in files changed vs REV + untracked "
+                         "files — the fast pre-commit run")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -68,6 +113,17 @@ def main(argv: list[str] | None = None) -> int:
     baseline = load_baseline(baseline_path) if baseline_path else []
     new, old, stale = apply_baseline(findings, baseline)
 
+    scope = ""
+    if args.diff is not None:
+        changed = changed_files(args.diff, args.root)
+        if changed is None:
+            print(f"flowlint: --diff {args.diff}: git could not resolve the "
+                  f"rev; reporting the full tree", file=sys.stderr)
+        else:
+            new = [f for f in new if f.path in changed]
+            stale = [b for b in stale if b["path"] in changed]
+            scope = f" in {len(changed)} changed file(s) vs {args.diff}"
+
     if args.as_json:
         print(json.dumps({
             "new": [f.__dict__ for f in new],
@@ -81,7 +137,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{b['path']}:{b['line']}: [{b['rule']}] STALE baseline "
                   f"entry — the site no longer trips the rule; delete it "
                   f"from {baseline_path}")
-        print(f"flowlint: {len(new)} new finding(s), {len(old)} baselined, "
+        print(f"flowlint: {len(new)} new finding(s){scope}, {len(old)} baselined, "
               f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
               f"({len(rules)} rules)")
     return 1 if (new or stale) else 0

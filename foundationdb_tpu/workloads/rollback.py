@@ -124,7 +124,9 @@ class RollbackWorkload(Workload):
 
         async def read_all(tr):
             out = {}
-            for key in self.acked:
+            # snapshot: tr.get suspends, and a retried read_all must walk a
+            # stable key list even if a straggler writer raced in (flowcheck)
+            for key in list(self.acked):
                 out[key] = await tr.get(key)
             return out
 

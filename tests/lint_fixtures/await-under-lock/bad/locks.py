@@ -1,0 +1,36 @@
+class Pipeline:
+    def __init__(self, loop, make_mutex):
+        self.loop = loop
+        self._lock = make_mutex()
+
+    async def flush(self):
+        with self._lock:               # a THREAD lock
+            await self.loop.delay(0.1)  # run loop parks holding it
+
+
+class Store:
+    def __init__(self, mutex):
+        self.mutex = mutex
+
+    async def _compact(self):
+        async with self.mutex:
+            return 1
+
+    async def write(self, k):
+        async with self.mutex:
+            await self._compact()      # re-acquires self.mutex: deadlock
+
+
+class Table:
+    def __init__(self, loop, mutex):
+        self.loop = loop
+        self.mutex = mutex
+        self.rows = {}
+
+    async def insert(self, k, v):
+        async with self.mutex:
+            self.rows[k] = v           # the lock protocol for rows
+
+    async def wipe(self):
+        await self.loop.delay(0.01)    # this method CAN interleave ...
+        self.rows = {}                 # ... and writes without the lock

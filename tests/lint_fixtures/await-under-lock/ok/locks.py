@@ -1,0 +1,29 @@
+class Pipeline:
+    def __init__(self, loop, make_mutex):
+        self.loop = loop
+        self._lock = make_mutex()
+        self.n = 0
+
+    async def flush(self):
+        with self._lock:
+            self.n += 1                # synchronous critical section only
+        await self.loop.delay(0.1)     # suspension OUTSIDE the lock
+
+
+class Store:
+    def __init__(self, mutex):
+        self.mutex = mutex
+        self.rows = {}
+
+    async def _size_unlocked(self):
+        return len(self.rows)
+
+    async def write(self, k, v):
+        async with self.mutex:
+            self.rows[k] = v
+            await self._size_unlocked()  # callee takes no lock
+
+    async def wipe_atomic(self):
+        self.rows = {}                 # never suspends: atomic on the
+        #                                single-threaded loop — no lock
+        #                                needed, exactly per the hint

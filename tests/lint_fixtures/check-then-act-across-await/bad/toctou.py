@@ -1,0 +1,12 @@
+class Election:
+    def __init__(self, loop):
+        self.loop = loop
+        self.leader = None
+
+    def set_leader(self, who):
+        self.leader = who  # another actor can win while we sleep
+
+    async def elect(self, me):
+        if self.leader is None:        # check
+            await self.loop.delay(0.1)  # scheduler runs other actors
+            self.leader = me           # act: tested state, unrechecked

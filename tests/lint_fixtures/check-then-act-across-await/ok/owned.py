@@ -1,0 +1,19 @@
+class Election:
+    def __init__(self, loop):
+        self.loop = loop
+        self.leader = None
+
+    def set_leader(self, who):
+        self.leader = who
+
+    async def elect_owned(self, me):
+        if self.leader is None:
+            self.leader = me           # ownership taken BEFORE suspending
+            await self.loop.delay(0.1)
+            self.leader = me           # release-style write: owned
+
+    async def elect_recheck(self, me):
+        if self.leader is None:
+            await self.loop.delay(0.1)
+            if self.leader is None:    # re-checked after resumption
+                self.leader = me

@@ -1,0 +1,25 @@
+from functools import partial
+
+
+class Worker:
+    async def flush_all(self):
+        return 1
+
+    def kick_alias(self):
+        f = self.flush_all             # method alias to an async def
+        f()                            # coroutine built, dropped
+
+    def kick_partial(self):
+        f = partial(self.flush_all)
+        f()                            # partial-wrapped coroutine dropped
+
+    def kick_lambda(self):
+        f = lambda: self.flush_all()   # noqa: E731 — the fixture shape
+        f()                            # lambda-wrapped coroutine dropped
+
+    def kick_inline(self):
+        partial(self.flush_all)()      # called and dropped in one statement
+
+    def kick_spawn(self, loop):
+        loop.spawn(partial(self.flush_all))  # factory, not a coroutine:
+        #                                      spawn builds nothing

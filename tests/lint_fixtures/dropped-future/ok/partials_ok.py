@@ -1,0 +1,18 @@
+from functools import partial
+
+
+class Worker:
+    async def flush_all(self):
+        return 1
+
+    def kick_bg(self, loop):
+        f = partial(self.flush_all)
+        return loop.spawn(f())         # invoked: a coroutine reaches spawn
+
+    async def kick_alias(self):
+        f = self.flush_all
+        await f()                      # awaited through the alias
+
+    def factory(self):
+        f = partial(self.flush_all)
+        return f                       # stored/returned, not dropped

@@ -1,0 +1,16 @@
+class TLogLike:
+    def __init__(self, loop, stream):
+        self.loop = loop
+        self.stream = stream
+        self.locked = False
+
+    def lock(self):
+        self.locked = True  # recovery ends this epoch
+
+    async def serve_one(self):
+        req = await self.stream.next()
+        if self.locked:
+            return
+        await self.loop.delay(0.001)   # e.g. the durability sync
+        req.reply("ok")                # lock not re-validated: a commit
+        #                                acked into a dead epoch

@@ -1,0 +1,21 @@
+class TLogLike:
+    def __init__(self, loop, stream):
+        self.loop = loop
+        self.stream = stream
+        self.locked = False
+
+    def lock(self):
+        self.locked = True
+
+    async def serve_one(self):
+        req = await self.stream.next()
+        if self.locked:
+            return
+        await self.loop.delay(0.001)
+        if self.locked:                # re-validated after resumption
+            return
+        req.reply("ok")
+
+    async def serve_inline(self):
+        req = await self.stream.next()
+        req.reply(self.locked)         # read in the reply statement: fresh

@@ -1,0 +1,20 @@
+class Region:
+    def __init__(self, loop):
+        self.loop = loop
+        self.replicas = []
+        self.index = {}
+
+    def rebuild(self, i, ss):
+        self.replicas[i] = ss  # rebuilt in place while others iterate
+
+    def track(self, k, v):
+        self.index[k] = v
+
+    async def converge(self, vm):
+        for ss in self.replicas:           # live iteration ...
+            while ss.version < vm:
+                await self.loop.delay(0.05)  # ... across scheduling points
+
+    async def broadcast(self):
+        for k, v in self.index.items():    # live dict view across awaits
+            await self.loop.delay(0.01)

@@ -1,0 +1,30 @@
+class Region:
+    def __init__(self, loop):
+        self.loop = loop
+        self.replicas = []
+        self.index = {}
+
+    def rebuild(self, i, ss):
+        self.replicas[i] = ss
+
+    def track(self, k, v):
+        self.index[k] = v
+
+    async def converge(self, vm):
+        # snapshot the tags, then re-resolve from the LIVE set every poll
+        for tag in [ss.tag for ss in self.replicas]:
+            while True:
+                ss = next(
+                    (s for s in self.replicas if s.tag == tag), None
+                )
+                if ss is None or ss.version >= vm:
+                    break
+                await self.loop.delay(0.05)
+
+    async def broadcast(self):
+        for k in list(self.index):         # snapshot iteration
+            await self.loop.delay(0.01)
+
+    async def sync_only(self):
+        for ss in self.replicas:
+            ss.poke()                      # no suspension in the body

@@ -1,0 +1,12 @@
+class Controller:
+    def __init__(self, loop):
+        self.loop = loop
+        self.generation = None
+
+    def swap(self, gen):
+        self.generation = gen  # rebound outside __init__: shared mutable
+
+    async def act(self):
+        gen = self.generation          # cached before the suspension
+        await self.loop.delay(0.1)
+        return gen.proxies             # stale use: no re-read, no guard

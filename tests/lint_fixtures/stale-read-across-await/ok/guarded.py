@@ -1,0 +1,28 @@
+class Controller:
+    def __init__(self, loop):
+        self.loop = loop
+        self.generation = None
+
+    def swap(self, gen):
+        self.generation = gen
+
+    async def reread(self):
+        gen = self.generation
+        await self.loop.delay(0.1)
+        gen = self.generation          # re-read after the await: fresh
+        return gen
+
+    async def token_compare(self):
+        gen = self.generation
+        await self.loop.delay(0.1)
+        if gen is not self.generation:  # identity guard: managed cache
+            return None
+        return gen
+
+    async def quick(self):
+        return 1                       # no awaits: runs synchronously
+
+    async def nonsuspending(self):
+        gen = self.generation
+        await self.quick()             # not a real scheduling point
+        return gen

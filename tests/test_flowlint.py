@@ -54,7 +54,14 @@ def test_fixture_dirs_cover_every_rule():
     fixture dir for a deleted rule) fails here before it can rot."""
     ids = {r.id for r in default_rules()} | {"suppression"}
     assert ids == set(RULE_DIRS)
-    assert len(default_rules()) >= 9  # the acceptance floor
+    # PR-12 floor: the 10 PR-9 rules plus the 5 flowcheck interleaving
+    # rules (docs/LINT.md "Interleaving hazards") — a new rule landing
+    # without a fixture pair fails the set equality above
+    assert len(default_rules()) >= 15
+    for rule in ("stale-read-across-await", "check-then-act-across-await",
+                 "epoch-guard-missing", "await-under-lock",
+                 "mutate-while-iterating-across-await"):
+        assert rule in ids
 
 
 @pytest.mark.parametrize("rule", RULE_DIRS)
@@ -82,6 +89,63 @@ def test_findings_carry_fix_hints():
     findings = lint_fixture("wall-clock", "bad")
     assert findings and all(f.hint for f in findings if f.rule == "wall-clock")
     assert any("bound clock" in f.hint for f in findings)
+
+
+# -- the PR-9 effect-summary blind spot (partial/lambda/alias) ----------------
+
+
+def test_dropped_future_sees_through_partial_lambda_and_alias():
+    """Each wrapper shape is pinned individually: an async callable bound
+    via functools.partial, a trivial lambda, or a method-alias assignment
+    must still read as async when its call is dropped — and a partial (or
+    the bare callable) handed to spawn() builds NO coroutine at all."""
+    hits = [f for f in lint_fixture("dropped-future", "bad")
+            if f.rule == "dropped-future"
+            and f.path.endswith("partials.py")]
+    msgs = "\n".join(f"{f.line}: {f.message}" for f in hits)
+    assert any("alias" in f.message and "'f'" in f.message for f in hits), msgs
+    assert any("partial-wrapped" in f.message for f in hits), msgs
+    assert sum(
+        1 for f in hits if "bound via partial/lambda/alias" in f.message
+    ) >= 3, msgs  # the alias, partial, and lambda bindings each fire
+    assert any("spawn() received" in f.message for f in hits), msgs
+    assert len(hits) >= 5, msgs
+
+
+# -- flowcheck interleave rules: effect-census precision ----------------------
+
+
+def test_nonsuspending_await_is_not_a_scheduling_point():
+    """Awaiting a coroutine that never reaches a real suspension runs
+    synchronously under this runtime — the ok fixture's `nonsuspending`
+    case only stays silent because the effect census resolves
+    `await self.quick()` transitively.  Pin the census directly too."""
+    from foundationdb_tpu.lint import LintContext, SourceFile
+    from foundationdb_tpu.lint.dataflow import EffectCensus
+
+    src = (
+        "class A:\n"
+        "    async def quick(self):\n"
+        "        return 1\n"
+        "    async def chain(self):\n"
+        "        return await self.quick()\n"
+        "    async def slow(self, loop):\n"
+        "        await loop.delay(1)\n"
+        "    async def chain_slow(self):\n"
+        "        return await self.slow(None)\n"
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        f = pathlib.Path(d) / "foundationdb_tpu" / "m.py"
+        f.parent.mkdir()
+        f.write_text(src)
+        sf = SourceFile(str(f), str(f.relative_to(d)), "package")
+        census = EffectCensus(LintContext([sf], d))
+    assert not census.summaries["A.quick"].suspends
+    assert not census.summaries["A.chain"].suspends  # transitive
+    assert census.summaries["A.slow"].suspends       # opaque await
+    assert census.summaries["A.chain_slow"].suspends
 
 
 # -- suppression semantics ----------------------------------------------------
@@ -182,6 +246,50 @@ def test_flag_only_invocation_defaults_to_the_tree(capsys):
     assert rc == 0, out
     doc = json.loads(out)
     assert doc["new"] == [] and doc["stale_baseline"] == []
+
+
+def test_diff_mode_reports_only_changed_files(tmp_path):
+    """`flowlint --diff REV` still ANALYZES the full tree (cross-file
+    censuses need everything in view) but reports and gates only on
+    findings in files changed vs REV + untracked files — the pre-commit
+    spelling wired through `cli lint --diff`."""
+    import os
+
+    # the git toplevel sits ABOVE the lint root (review pin: `git diff
+    # --relative` keeps changed paths in the root-relative dialect the
+    # findings use — toplevel-relative names would empty the intersection
+    # and silently gate nothing)
+    ws = tmp_path / "ws"
+    pkg = ws / "foundationdb_tpu"
+    pkg.mkdir(parents=True)
+    bad = pkg / "bad.py"
+    bad.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+    clean = pkg / "clean.py"
+    clean.write_text("def g():\n    return 1\n")
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+    def git(*args):
+        r = subprocess.run(["git", *args], cwd=tmp_path, env=env,
+                           capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    clean.write_text("def g():\n    return 2\n")  # only the CLEAN file changes
+
+    argv = [str(pkg), "--root", str(ws)]
+    # full run: the unchanged bad file fails the tree
+    assert flowlint_main(argv) == 1
+    # diff run: bad.py is unchanged vs HEAD, so nothing gates
+    assert flowlint_main(argv + ["--diff", "HEAD"]) == 0
+    # touching the bad file brings its finding back into scope
+    bad.write_text("import time\n\n\ndef f():\n    return time.time()\n\n")
+    assert flowlint_main(argv + ["--diff", "HEAD"]) == 1
+    # an unresolvable rev falls back to the full report, never to silence
+    assert flowlint_main(argv + ["--diff", "no-such-rev"]) == 1
+
 
 
 def test_metrics_schema_rule_fails_loudly_when_emitter_scan_breaks(tmp_path):

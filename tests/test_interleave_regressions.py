@@ -1,0 +1,194 @@
+"""Regression pins for interleaving hazards the flowcheck audit found.
+
+PR 12's flowcheck rules (docs/LINT.md "Interleaving hazards") audited the
+tree for state read before an `await` and trusted after it.  Two of the
+findings were REAL bugs; each test here crafts the exact interleaving and
+was demonstrated to fail on the pre-fix code:
+
+  * `RecoverableCluster._promote_remote_region` pinned the promotion's
+    convergence wait to the replica OBJECTS captured before the wait.  A
+    remote replica power-killed and rebuilt mid-wait
+    (`restart_remote_region` replaces the object in place) left the
+    promotion polling a dead server's frozen version forever — a
+    configured failover that never completes, with the cluster already
+    committed to the promoted map.
+
+  * `Transaction.get_read_version` checked `_read_version is None` and
+    assigned it after the GRV await.  Two reads racing the FIRST read
+    version each passed the check and issued their own GRV; landing in
+    different proxy batches pins two DIFFERENT snapshots to one
+    transaction (reads before/after disagree about committed data).  The
+    fix takes ownership of the fetch before suspending — followers share
+    the leader's future, one GRV per transaction (the reference caches
+    Future<Version>, NativeAPI's readVersion).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.runtime import buggify as bg
+from foundationdb_tpu.runtime.core import DeterministicRandom, TimedOut
+
+
+def test_promotion_survives_remote_region_rebuild_mid_wait():
+    """Park a region failover in its convergence wait (dead router ⇒ the
+    remote replicas cannot advance), then rebuild the whole remote region
+    from disk.  The promotion must re-resolve the replicas from the LIVE
+    set and complete; pre-fix it watched the dead objects forever."""
+    from foundationdb_tpu.control.region import teams_promoted
+
+    c = RecoverableCluster(seed=9301, n_storage_shards=1, remote_region=True)
+    try:
+        db = c.database()
+        loop = c.loop
+
+        async def put(tr):
+            tr.set(b"ir-k1", b"v1")
+
+        loop.run_until(loop.spawn(db.run(put)), 200.0)
+        # let the relay land the write remotely, so the replicas are live
+        # at SOME version before they stall
+        fut = loop.spawn(_wait_remote_nonzero(c))
+        loop.run_until(fut, loop.now() + 60.0)
+
+        # stall the remote plane: the router dies, replicas stop advancing
+        c.log_router.process.kill()
+
+        async def put2(tr):
+            tr.set(b"ir-k2", b"v2")
+
+        loop.run_until(loop.spawn(db.run(put2)), loop.now() + 200.0)
+
+        promo = loop.spawn(c.promote_remote_region())
+        # drive until the promoted map is installed — the promotion is now
+        # inside its convergence wait (remote versions < boundary, and
+        # they cannot advance: the router is dead)
+        for _ in range(200_000):
+            if teams_promoted(c.controller.storage_teams_tags):
+                break
+            loop.run_one()
+        assert teams_promoted(c.controller.storage_teams_tags)
+        for _ in range(200):
+            loop.run_one()
+        assert not promo.done(), "test setup: promotion must be parked"
+
+        # the audited interleaving: every remote replica is power-killed
+        # and the region is rebuilt from its disks — the replica OBJECTS
+        # the promotion captured are now corpses
+        for ss in list(c.remote_storage):
+            ss.process.kill()
+        c.restart_remote_region()
+
+        assert loop.run_until(promo, loop.now() + 300.0) is True
+
+        async def read(tr):
+            return [await tr.get(b"ir-k1"), await tr.get(b"ir-k2")]
+
+        got = loop.run_until(loop.spawn(db.run(read)), loop.now() + 300.0)
+        assert got == [b"v1", b"v2"]
+    finally:
+        c.stop()
+
+
+async def _wait_remote_nonzero(c):
+    while not all(ss.version.get() > 0 for ss in c.remote_storage):
+        await c.loop.delay(0.05)
+
+
+def test_reset_during_grv_fetch_never_pins_the_stale_leader_version():
+    """Review pin on the single-flight fix itself: a reset() while the
+    GRV leader's RPC is in flight disowns that fetch — when the OLD
+    leader's reply lands AFTER the retry's new fetch resolved, it must
+    NOT stamp the pre-reset version onto the retried transaction."""
+    from foundationdb_tpu.cluster import SimCluster
+    from foundationdb_tpu.runtime.core import Promise
+
+    c = SimCluster(seed=3)
+    try:
+        loop = c.loop
+        db = c.database()
+        tr = db.create_transaction()
+        gates: list[Promise] = []
+
+        async def fake_fetch():
+            p = Promise()
+            gates.append(p)
+            return await p.future
+
+        tr._fetch_read_version = fake_fetch
+        def drive_until(pred):
+            for _ in range(100_000):
+                if pred():
+                    return
+                if not loop.run_one():
+                    break  # idle loop: spinning would hang the test
+            assert pred(), "test setup: condition never reached"
+
+        ta = loop.spawn(tr.get_read_version())   # leader A
+        drive_until(lambda: len(gates) >= 1)
+        tr.reset()                               # retry path: disowns A
+        tb = loop.spawn(tr.get_read_version())   # NEW leader B
+        drive_until(lambda: len(gates) >= 2)
+        gates[1].send(200)                       # the retry's version lands
+        loop.run_until(tb, loop.now() + 5.0)
+        assert tb.result() == 200
+        gates[0].send(100)                       # the STALE reply lands late
+        loop.run_until(ta, loop.now() + 5.0)
+        # the disowned leader must not clobber the retry's snapshot — and
+        # its own caller follows the live value instead of the stale one
+        assert tr._read_version == 200
+        assert ta.result() == 200
+    finally:
+        c.stop()
+
+
+def test_concurrent_first_reads_share_one_read_version():
+    """Two reads racing a transaction's FIRST get_read_version must pin
+    ONE snapshot.  The forced `proxy.delay_grv` splits the two GRVs into
+    separate proxy batches with the committed version advancing in
+    between — pre-fix the two callers observed different versions."""
+    from foundationdb_tpu.cluster import SimCluster
+
+    c = SimCluster(seed=31)
+    try:
+        bg.enable(DeterministicRandom(7), enable_prob=0.0, fire_prob=0.0)
+        bg.force("proxy.delay_grv", times=2)
+        db = c.database()
+        tr = db.create_transaction()
+        got = {}
+
+        async def read(which):
+            got[which] = await tr.get_read_version()
+
+        ta = c.loop.spawn(read("a"))
+        # drive until A's batch entered its FORCED delay (the force budget
+        # decrements exactly when maybe_delay consumes it) — the GRV server
+        # is now parked mid-batch with A admitted
+        for _ in range(100_000):
+            c.loop.run_one()
+            if bg.snapshot()["forced"].get("proxy.delay_grv", 0) < 2:
+                break
+            if ta.done():
+                break
+        assert bg.snapshot()["forced"].get("proxy.delay_grv", 0) == 1, (
+            "test setup: A's GRV batch never reached the forced delay"
+        )
+        assert not ta.done(), "test setup: A must still be in flight"
+
+        tb = c.loop.spawn(read("b"))
+        while not ta.done():
+            c.loop.run_one()
+        # the cluster commits between the two GRV batches
+        c.proxy.committed_version.set(
+            c.proxy.committed_version.get() + 1_000_000
+        )
+        c.loop.run_until(tb, c.loop.now() + 30.0)
+        assert got["a"] == got["b"], (
+            f"one transaction observed two snapshots: {got}"
+        )
+        assert tr._read_version == got["a"]
+    finally:
+        bg.disable()
+        c.stop()
