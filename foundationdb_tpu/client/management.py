@@ -26,12 +26,15 @@ COORDINATORS_KEY = CONF_PREFIX + b"coordinators"
 _FIELDS = ("n_tlogs", "n_proxies", "n_resolvers")
 
 
-async def configure(db, redundancy: str | None = None, **kwargs) -> None:
-    """Commit new role counts and/or a redundancy mode, e.g.
-    configure(db, n_tlogs=3) or configure(db, redundancy="triple").
-    Role counts take effect at the controller's next conf poll via a
-    recovery; a redundancy flip converges online through data distribution
-    (one replica change per poll)."""
+async def configure(db, redundancy: str | None = None,
+                    engine: str | None = None, **kwargs) -> None:
+    """Commit new role counts, a redundancy mode, and/or a storage engine,
+    e.g. configure(db, n_tlogs=3), configure(db, redundancy="triple"),
+    configure(db, engine="ssd").  Role counts take effect at the
+    controller's next conf poll via a recovery; a redundancy flip
+    converges online through data distribution (one replica change per
+    poll); an engine flip migrates one replica at a time through the dd
+    heal path (the reference's `configure ssd` re-replication)."""
     bad = set(kwargs) - set(_FIELDS)
     if bad:
         raise ValueError(f"unknown configuration fields: {sorted(bad)}")
@@ -42,12 +45,16 @@ async def configure(db, redundancy: str | None = None, **kwargs) -> None:
         from ..rpc.policy import policy_for_redundancy
 
         policy_for_redundancy(redundancy)  # validate the mode name
+    if engine is not None and engine not in ("memory", "ssd"):
+        raise ValueError(f"unknown storage engine {engine!r}")
 
     async def fn(tr):
         for k, v in kwargs.items():
             tr.set(CONF_PREFIX + k.encode(), b"%d" % int(v))
         if redundancy is not None:
             tr.set(CONF_PREFIX + b"redundancy", redundancy.encode())
+        if engine is not None:
+            tr.set(CONF_PREFIX + b"engine", engine.encode())
 
     await db.run(fn)
 

@@ -82,7 +82,11 @@ class SimCluster:
         self.tlogs: list[TLog] = []
         for i in range(n_tlogs):
             p = self.net.create_process(f"tlog-{i}")
-            self.tlogs.append(TLog(p, self.loop))
+            self.tlogs.append(TLog(
+                p, self.loop,
+                hard_limit_bytes=self.knobs.TLOG_HARD_LIMIT_BYTES,
+                trace=self.trace,
+            ))
 
         self.resolvers: list[Resolver] = []
         for i in range(n_resolvers):

@@ -81,6 +81,7 @@ def parse_conf_rows(rows) -> dict:
     coord_n: int | None = None
     maint: dict[str, float] = {}
     redundancy: str | None = None
+    engine: str | None = None
     throttle: float | None = None
     rows = list(rows)
     for k, v in rows:
@@ -110,6 +111,12 @@ def parse_conf_rows(rows) -> dict:
             except UnicodeDecodeError:
                 pass
             continue
+        if k == CONF_PREFIX + b"engine":
+            try:
+                engine = v.decode()
+            except UnicodeDecodeError:
+                pass
+            continue
         if k == CONF_PREFIX + b"throttle_tps":
             try:
                 throttle = float(v)
@@ -123,7 +130,7 @@ def parse_conf_rows(rows) -> dict:
     return {
         "conf": conf, "excluded": excluded, "locked": locked,
         "coord_n": coord_n, "maint": maint, "redundancy": redundancy,
-        "throttle": throttle,
+        "engine": engine, "throttle": throttle,
         # presence only: the conf WATCH decodes the region rows itself with
         # the APPLIED config as the torn-row fallback base — a decoded-
         # without-base config here would carry the default-decay semantics
@@ -264,6 +271,12 @@ class ClusterController:
 
         self.region_config = RegionConfiguration()
         self.on_region_change = None        # async (new, old) -> bool
+        # storage-engine swap (configure engine=): the cluster assembly
+        # installs the hook (it owns store construction) and the APPLIED
+        # getter — recorded only on full convergence, so a half-migrated
+        # swap keeps reading as drift and is resumed by the next poll
+        self.on_engine_change = None        # async (engine) -> None
+        self.applied_engine = None          # () -> str, assembly-installed
         # live storage replicas OUTSIDE the keyServers teams that also hold
         # the `\xff/conf/` shard (the remote region's replicas): the conf
         # watch reads through them when every primary replica of the shard
@@ -824,7 +837,18 @@ class ClusterController:
             for tag in t:
                 w.str_(tag)
         dq = self._keyservers_dq()
-        dq.rewrite([w.data()])
+        for attempt in range(3):
+            try:
+                dq.rewrite([w.data()])
+                break
+            except IOError:
+                # transient disk fault (injection plane): the journaled
+                # truncate un-wound itself, the previous assignment is
+                # still recoverable — retry; a persistently refusing disk
+                # surfaces to the caller (dd aborts the move)
+                if attempt == 2:
+                    raise
+                await self.loop.delay(0.02, TaskPriority.COORDINATION)
         await dq.sync()
 
     def _recover_key_servers(self) -> None:
@@ -1128,7 +1152,9 @@ class ClusterController:
                      initial_tags=tlog_seeds[i],
                      known_committed=recovery_version,
                      disk_queue=dq,
-                     spill_bytes=self.knobs.TLOG_SPILL_BYTES)
+                     spill_bytes=self.knobs.TLOG_SPILL_BYTES,
+                     hard_limit_bytes=self.knobs.TLOG_HARD_LIMIT_BYTES,
+                     trace=self.trace)
             )
 
         resolvers: list[Resolver] = []
@@ -1583,6 +1609,28 @@ class ClusterController:
                         TaskPriority.COORDINATION, "cc-region",
                     )
 
+            # storage-engine swap (configure engine=ssd/memory): a
+            # replica-at-a-time migration through the dd heal path, run as
+            # a BACKGROUND step like redundancy/region — it kills and
+            # re-replicates servers, which takes many polls.  Drift is
+            # desired-vs-APPLIED: the hook records the applied engine only
+            # once every replica converged, so a failed half-migration is
+            # re-entered (and resumed where it stopped) next poll.
+            engine = parsed["engine"]
+            if (
+                engine is not None
+                and self.on_engine_change is not None
+                and self.applied_engine is not None
+                and engine != self.applied_engine()
+                and engine != getattr(self, "_engine_rejected", None)
+            ):
+                t = getattr(self, "_engine_step_task", None)
+                if t is None or t.done():
+                    self._engine_step_task = self.loop.spawn(
+                        self._engine_step(engine),
+                        TaskPriority.COORDINATION, "cc-engine",
+                    )
+
             want_tlogs = conf.get("n_tlogs", len(gen.tlogs))
             want_proxies = conf.get("n_proxies", len(gen.proxies))
             want_res = conf.get("n_resolvers", len(gen.resolvers))
@@ -1641,6 +1689,32 @@ class ClusterController:
         except Exception as e:  # noqa: BLE001 — next poll respawns
             self.trace.trace("RedundancyChangeError", Error=repr(e))
 
+    async def _engine_step(self, engine: str) -> None:
+        """One storage-engine migration, off the conf watch's critical
+        path (the `configure ssd` re-replication: kill one replica per
+        heal, data distribution rebuilds it on the new engine)."""
+        try:
+            await self.on_engine_change(engine)
+            testcov("management.engine_swapped")
+            self.trace.trace(
+                "StorageEngineChanged", Engine=engine, Epoch=self.epoch
+            )
+        except ActorCancelled:
+            raise  # teardown, not a failed swap
+        except ValueError as e:
+            # PERMANENT refusal (replication too low, no durable fs): the
+            # desired config is infeasible on this cluster, and re-entering
+            # it every poll would trace-spam forever.  Record the rejected
+            # value — the watch skips it until the operator configures
+            # something else (review finding).
+            self._engine_rejected = engine
+            self.trace.trace(
+                "StorageEngineChangeRejected", Engine=engine, Error=repr(e)
+            )
+        except Exception as e:  # noqa: BLE001 — next poll re-detects the
+            # desired-vs-applied drift and resumes the migration
+            self.trace.trace("StorageEngineChangeError", Error=repr(e))
+
     # -- failure monitoring -------------------------------------------------
     async def _monitor(self) -> None:
         """Heartbeat every pipeline process (the CC's failure monitor; the
@@ -1687,6 +1761,11 @@ class ClusterController:
             self._region_change_task.cancel()
         if getattr(self, "_redundancy_step_task", None) is not None:
             self._redundancy_step_task.cancel()
+        if getattr(self, "_engine_step_task", None) is not None:
+            # a mid-migration engine swap dies with its controller; the
+            # desired-vs-applied drift survives in `\xff/conf/` for the
+            # next life to resume
+            self._engine_step_task.cancel()
         if getattr(self, "_register_task", None) is not None:
             self._register_task.cancel()
         if getattr(self, "_balance_task", None) is not None:

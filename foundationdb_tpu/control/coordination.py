@@ -22,6 +22,7 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.combinators import wait_any
 from ..runtime.buggify import maybe_delay
 from ..runtime.core import EventLoop, Future, Promise, TaskPriority, TimedOut
+from ..runtime.coverage import testcov
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -131,6 +132,27 @@ class Coordinator:
         )
         await dq.sync()
 
+    async def _persist_retried(self) -> bool:
+        """Persist the register, retrying transient disk faults (the
+        injected-error plane, storage/files.py) a few times.  False —
+        persistently refused — means the caller must NOT ack the request:
+        a promise/write that is not durable may not be acknowledged.  It
+        must equally NOT kill the serve loop, which would take this
+        coordinator out of the quorum forever (found by the DiskSwizzle
+        chaos: erode 2 of 3 registers and recovery wedges for good).  The
+        in-memory state staying stricter/ahead of disk is the safe
+        direction — the prepared-but-unacked state every quorum round
+        already tolerates."""
+        for attempt in range(3):
+            try:
+                await self._persist()
+                return True
+            except IOError:
+                testcov("coord.persist_io_error")
+                await self.loop.delay(0.02 * (attempt + 1),
+                                      TaskPriority.COORDINATION)
+        return False
+
     async def _serve_read(self) -> None:
         while True:
             req = await self.read_stream.next()
@@ -138,8 +160,8 @@ class Coordinator:
             r: ReadRegRequest = req.payload
             if r.read_gen > self.promised:
                 self.promised = r.read_gen
-                if self._file is not None:
-                    await self._persist()  # promise must survive a reboot
+                if self._file is not None and not await self._persist_retried():
+                    continue  # refused: requester times out and retries
             req.reply(ReadRegReply(self.value, self.write_gen, self.promised))
 
     async def _serve_write(self) -> None:
@@ -151,8 +173,8 @@ class Coordinator:
                 self.promised = r.write_gen
                 self.write_gen = r.write_gen
                 self.value = r.value
-                if self._file is not None:
-                    await self._persist()  # durable before the ack
+                if self._file is not None and not await self._persist_retried():
+                    continue  # refused: no durable write, no ack
                 req.reply(WriteRegReply(True, self.promised))
             else:
                 req.reply(WriteRegReply(False, self.promised))

@@ -125,8 +125,11 @@ class DataDistributor:
                     if cc._tag_to_ss.get(ss.tag) is ss:  # not already healed
                         try:
                             await self._heal(ss)
-                        except (TimedOut, BrokenPromise):
-                            continue  # mid-recovery; next tick retries
+                        except (TimedOut, BrokenPromise, IOError):
+                            # mid-recovery, or the disk fault plane refused
+                            # a store/keyservers write; next tick retries —
+                            # the heal loop itself must never die
+                            continue
 
     def _in_maintenance(self, ss: StorageServer) -> bool:
         zones = getattr(self.cc, "maintenance_zones", {})
@@ -663,7 +666,10 @@ class DataDistributor:
                         and wrates[i] + wrates[i + 1]
                         < self.knobs.DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC / 2
                     ):
-                        await self._merge_shards(i)
+                        try:
+                            await self._merge_shards(i)
+                        except IOError:
+                            break  # disk fault plane; next tick recomputes
                         self._sizes = None  # boundary count changed
                         break
                 continue
@@ -677,7 +683,12 @@ class DataDistributor:
             if cold is None:
                 continue
             e = bounds[hot + 1]
-            moved = await self.move_range(key, e, list(teams[cold]))
+            try:
+                moved = await self.move_range(key, e, list(teams[cold]))
+            except IOError:
+                # the keyservers/store disk refused mid-move (fault plane):
+                # the split loop must survive and retry next tick
+                continue
             if moved:
                 self.shard_splits += 1
                 self._split_boundaries.add(key)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from ..roles.storage import StorageServer
 from ..runtime.core import EventLoop, TaskPriority
+from ..runtime.coverage import testcov
 from ..runtime.knobs import CoreKnobs
 from ..runtime.metrics import Smoother
 from ..runtime.trace import SEV_INFO, SEV_WARN
@@ -46,8 +47,13 @@ class Ratekeeper:
         self.manual_tps_cap: float | None = None
         self.limit_reason = "unlimited"
         self.limiting_server: str | None = None
+        # e-brake: a queue crossed its HARD limit or a disk is nearly full —
+        # the budget is slammed to the floor (no smoothing) until it clears
+        self.e_brake = False
         self._lag_smoothers: dict[str, Smoother] = {}
         self._queue_smoothers: dict[int, Smoother] = {}
+        self._squeue_smoothers: dict[str, Smoother] = {}
+        self._tlog_names: dict = {}  # endpoint token -> "tlogN" (status keys)
         self._budget = Smoother(
             knobs.RATEKEEPER_SMOOTHING_E, clock=loop.now
         )
@@ -75,30 +81,55 @@ class Ratekeeper:
         frac = max(0.0, (2 * target - lag) / target)
         return max(max_tps * frac, max_tps * 0.01)
 
+    def _free_space(self, ss) -> float | None:
+        """The storage server's disk free-space FRACTION, or None when its
+        store has no bounded disk (pure-memory engines, unlimited sim
+        disks) — the storage_server_min_free_space input."""
+        du = getattr(getattr(ss, "store", None), "disk_usage", None)
+        if du is None:
+            return None
+        used, cap = du()
+        if cap is None or cap <= 0:
+            return None
+        return max(0.0, 1.0 - used / cap)
+
     def _update(self) -> None:
         tps = self.max_tps
         reason = "unlimited"
         limiting = None
+        brake = None  # (server,) that crossed a HARD limit / min free space
 
         # TLog smoothers are keyed by the TLog's own endpoint token: a
         # recovery's fresh TLogs must start with fresh models, not inherit a
         # deposed slot-mate's backlog estimate; departed keys are pruned
         target_bytes = float(self.knobs.TARGET_QUEUE_BYTES)
+        hard_tlog = float(self.knobs.TLOG_HARD_LIMIT_BYTES)
         tlogs = self.tlogs_fn()
         live_keys = set()
+        self._tlog_names = {}
         for i, t in enumerate(tlogs):
             key = t.commit_stream.endpoint.token
             live_keys.add(key)
-            q = self._smoothed(self._queue_smoothers, key, float(t.bytes_queued))
+            self._tlog_names[key] = f"tlog{i}"
+            raw = float(t.bytes_queued)
+            q = self._smoothed(self._queue_smoothers, key, raw)
             lim = self._limit(q, target_bytes, self.max_tps)
             if lim < tps:
                 tps, reason, limiting = lim, "tlog_queue", f"tlog{i}"
+            if hard_tlog and raw >= hard_tlog and brake is None:
+                # the RAW gauge, not the smoothed model: the e-brake exists
+                # for exactly the moment smoothing would lag behind
+                brake = f"tlog{i}"
         for key in [k for k in self._queue_smoothers if k not in live_keys]:
             del self._queue_smoothers[key]
 
         # storage smoothers key by TAG: a healed replacement inherits its
         # predecessor's model on purpose (same data responsibility)
         target_lag = 2.0 * self.knobs.mvcc_window_versions
+        target_squeue = float(self.knobs.TARGET_STORAGE_QUEUE_BYTES)
+        hard_squeue = float(self.knobs.STORAGE_HARD_LIMIT_BYTES)
+        free_target = self.knobs.FREE_SPACE_TARGET_FRACTION
+        free_min = self.knobs.MIN_FREE_SPACE_FRACTION
         live_tags = set()
         for ss in self.storage:
             live_tags.add(ss.tag)
@@ -109,48 +140,104 @@ class Ratekeeper:
             lim = self._limit(lag, target_lag, self.max_tps)
             if lim < tps:
                 tps, reason, limiting = lim, "storage_lag", ss.tag
+            # bytes-in-queue spring (applied-above-durable; the reference's
+            # storage queue input to updateRate)
+            raw_q = float(getattr(ss, "queue_bytes", 0))
+            q = self._smoothed(self._squeue_smoothers, ss.tag, raw_q)
+            lim = self._limit(q, target_squeue, self.max_tps)
+            if lim < tps:
+                tps, reason, limiting = lim, "storage_queue", ss.tag
+            if hard_squeue and raw_q >= hard_squeue and brake is None:
+                brake = ss.tag
+            # free-space squeeze (storage_server_min_free_space): linear
+            # from full rate at the target fraction down to the floor at
+            # the minimum; at or below the minimum the e-brake engages
+            free = self._free_space(ss)
+            if free is not None and free < free_target:
+                frac = max(0.0, (free - free_min) / (free_target - free_min))
+                lim = max(self.max_tps * frac, self.max_tps * 0.01)
+                if lim < tps:
+                    tps, reason, limiting = lim, "free_space", ss.tag
+                if free <= free_min and brake is None:
+                    brake = ss.tag
         for tag in [t for t in self._lag_smoothers if t not in live_tags]:
             del self._lag_smoothers[tag]
+        for tag in [t for t in self._squeue_smoothers if t not in live_tags]:
+            del self._squeue_smoothers[tag]
 
         if self.manual_tps_cap is not None and self.manual_tps_cap < tps:
             tps, reason, limiting = self.manual_tps_cap, "manual_throttle", None
 
-        self._budget.set_total(tps)
-        self.tps_budget = max(self._budget.smooth_total(), self.max_tps * 0.01)
-        if self.manual_tps_cap is not None:
-            # the cap is a hard ceiling, not a smoothed target
-            self.tps_budget = min(self.tps_budget, self.manual_tps_cap)
-        # batch-priority budget (the reference's separate batch limit):
-        # batch traffic starves FIRST — it reaches zero while default-class
-        # work still has 25% of the full rate left
-        self.batch_tps_budget = max(
-            0.0, (self.tps_budget - 0.25 * self.max_tps) / 0.75
-        )
-        if self.trace is not None and reason != self.limit_reason:
-            # only on TRANSITIONS (not every 0.25s tick): the latest event
-            # is what status scrapes; WARN while limited makes it a message
-            self.trace.trace(
-                "RkUpdate",
-                severity=SEV_WARN if reason != "unlimited" else SEV_INFO,
-                track_latest="ratekeeper",
-                Reason=reason,
-                LimitingServer=limiting,
-                TPSBudget=round(self.tps_budget, 1),
+        self.e_brake = brake is not None
+        if brake is not None:
+            # e-brake: slam the budget to the floor NOW — the smoother's
+            # job is to keep transients from whipsawing admission, but a
+            # queue past its hard limit / a nearly-full disk is not a
+            # transient, and every admitted transaction digs the hole
+            # deeper.  The floor (0.1% of max) keeps the recovery path and
+            # operator transactions alive.
+            tps, reason, limiting = self.max_tps * 0.001, "e_brake", brake
+            self._budget.reset(tps)
+            self.tps_budget = tps
+            self.batch_tps_budget = 0.0
+        else:
+            self._budget.set_total(tps)
+            self.tps_budget = max(self._budget.smooth_total(), self.max_tps * 0.01)
+            if self.manual_tps_cap is not None:
+                # the cap is a hard ceiling, not a smoothed target
+                self.tps_budget = min(self.tps_budget, self.manual_tps_cap)
+            # batch-priority budget (the reference's separate batch limit):
+            # batch traffic starves FIRST — it reaches zero while
+            # default-class work still has 25% of the full rate left
+            self.batch_tps_budget = max(
+                0.0, (self.tps_budget - 0.25 * self.max_tps) / 0.75
             )
+        if reason != self.limit_reason:
+            if reason == "storage_queue":
+                testcov("ratekeeper.limit_storage_queue")
+            elif reason == "free_space":
+                testcov("ratekeeper.limit_free_space")
+            elif reason == "e_brake":
+                testcov("ratekeeper.e_brake")
+            if self.trace is not None:
+                # only on TRANSITIONS (not every 0.25s tick): the latest
+                # event is what status scrapes; WARN while limited makes it
+                # a message
+                self.trace.trace(
+                    "RkUpdate",
+                    severity=SEV_WARN if reason != "unlimited" else SEV_INFO,
+                    track_latest="ratekeeper",
+                    Reason=reason,
+                    LimitingServer=limiting,
+                    TPSBudget=round(self.tps_budget, 1),
+                )
         self.limit_reason = reason
         self.limiting_server = limiting
 
     def status(self) -> dict:
-        """The RkUpdate view: budget, binding constraint, per-server model."""
+        """The RkUpdate view: budget, binding constraint, per-server model.
+        TLog rows are attributed as `tlogN` (the limiting_server naming),
+        never raw endpoint tokens — the model is keyed by token internally
+        so recoveries reset it, but operators read slot names."""
         return {
             "tps_budget": self.tps_budget,
+            "batch_tps_budget": self.batch_tps_budget,
             "limit_reason": self.limit_reason,
             "limiting_server": self.limiting_server,
+            "e_brake": self.e_brake,
             "storage_lag_smoothed": {
                 tag: s.smooth_total() for tag, s in self._lag_smoothers.items()
             },
+            "storage_queue_smoothed": {
+                tag: s.smooth_total()
+                for tag, s in self._squeue_smoothers.items()
+            },
+            "free_space": {
+                ss.tag: self._free_space(ss) for ss in self.storage
+            },
             "tlog_queue_smoothed": {
-                i: s.smooth_total() for i, s in self._queue_smoothers.items()
+                self._tlog_names.get(k, f"tlog?{k[:6]}"): s.smooth_total()
+                for k, s in self._queue_smoothers.items()
             },
         }
 

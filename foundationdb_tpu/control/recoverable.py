@@ -20,6 +20,7 @@ from ..runtime.core import (
     DeterministicRandom,
     EventLoop,
     TaskPriority,
+    TimedOut,
 )
 from ..runtime.knobs import CoreKnobs
 from ..runtime.trace import TraceCollector
@@ -154,6 +155,11 @@ class RecoverableCluster:
                 self.fs = fs
             else:
                 self.fs = SimFilesystem(self.loop, self.rng)
+            # arm the io_timeout fail-fast + give the disks a trace handle
+            # (IoTimeoutKilled events; worker-recruited TLogs also reach
+            # the collector through fs.trace)
+            self.fs.io_timeout_s = self.knobs.IO_TIMEOUT_S
+            self.fs.trace = self.trace
 
         def splits(n: int) -> list[bytes]:
             return [bytes([256 * i // n]) for i in range(1, n)]
@@ -274,7 +280,29 @@ class RecoverableCluster:
                 from ..storage.kvstore import DurableMemoryKeyValueStore
 
                 cls_ = DurableMemoryKeyValueStore
-            return cls_.recover(self.fs, fname, p) if restart else cls_(self.fs, fname, p)
+            if restart:
+                # a reboot must find the engine the disks were actually
+                # written with: after an ONLINE engine swap (`configure
+                # engine=`) the saved image holds the OTHER engine's
+                # files, and recovering the configured engine against
+                # their absence would silently boot EMPTY stores — then
+                # resume the swap by re-fetching from equally-empty
+                # teammates (review finding: acked-data loss).  Refuse
+                # loudly; the operator boots with the engine the disks
+                # name.
+                mine = fname + ".hdr" if storage_engine == "ssd" else fname
+                other = fname if storage_engine == "ssd" else fname + ".hdr"
+                if not self.fs.exists(mine) and self.fs.exists(other):
+                    raise ValueError(
+                        f"storage engine mismatch on restart: {fname} "
+                        f"holds "
+                        f"{'memory' if storage_engine == 'ssd' else 'ssd'}"
+                        f"-engine files but the boot names "
+                        f"{storage_engine!r} (an online engine swap "
+                        f"preceded the save — boot with the disks' engine)"
+                    )
+                return cls_.recover(self.fs, fname, p)
+            return cls_(self.fs, fname, p)
 
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
@@ -409,7 +437,11 @@ class RecoverableCluster:
             """A replacement server takes over the dead one's store FILE as
             well as its tag: the restart path recovers per-tag `ss{i}r{r}.kv`
             names, so the healed data must live there, and the dead file's
-            durable prefix is a head start the snapshot fetch grounds over."""
+            durable prefix is a head start the snapshot fetch grounds over.
+            A FRESH create (no recoverable file of the current engine —
+            notably mid-engine-swap) deletes the OTHER engine's leftover
+            files first: appending a new store's records into a stale
+            other-format file would corrupt both lineages."""
             if self.fs is not None:
                 if self.storage_engine == "ssd":
                     from ..storage.btree import BTreeKeyValueStore as cls_
@@ -423,6 +455,8 @@ class RecoverableCluster:
                     path = f"ss{shard}r{rep}.kv"
                 if self.fs.exists(path if self.storage_engine != "ssd" else path + ".hdr"):
                     return cls_.recover(self.fs, path, proc)
+                for stale in (path, path + ".a", path + ".b", path + ".hdr"):
+                    self.fs.delete(stale)
                 return cls_(self.fs, path, proc)
             return MemoryKeyValueStore()
 
@@ -433,6 +467,12 @@ class RecoverableCluster:
         # `configure redundancy=` flips replication online through data
         # distribution (add/remove one replica per conf poll until converged)
         self.controller.on_redundancy_change = self.dd.converge_redundancy
+        # `configure engine=` migrates storage replica-by-replica through
+        # the dd heal path; applied is recorded only on full convergence
+        # so a failed half-migration keeps reading as drift and resumes
+        self._engine_applied = self.storage_engine
+        self.controller.on_engine_change = self.swap_storage_engine
+        self.controller.applied_engine = lambda: self._engine_applied
         # spawned LAST: an __init__ that raises above (team policy refusals,
         # bad config) must not leak a never-started emitter task — nothing
         # would ever cancel it
@@ -940,6 +980,70 @@ class RecoverableCluster:
             self.log_router = None
         testcov("region.router_retired")
         self.trace.trace("RegionRouterRetired", Boundary=vm)
+
+    async def swap_storage_engine(self, engine: str) -> None:
+        """Online storage-engine migration (the reference's `configure
+        ssd`/`memory`: the database re-replicates onto the new engine
+        while serving traffic).  One replica at a time: kill the replica's
+        process and let data distribution heal it — the replacement store
+        is built with the NEW engine (`storage_engine` flips first, the
+        heal factory reads it) and fetchKeys re-replicates the data from
+        live teammates.  Sequential by construction, so a team always
+        keeps a live source; resumable — already-converged replicas are
+        skipped, and the controller records the APPLIED engine only when
+        every replica matches."""
+        from ..runtime.combinators import timeout_error
+        from ..runtime.coverage import testcov
+
+        if engine not in ("memory", "ssd"):
+            raise ValueError(f"unknown storage engine {engine!r}")
+        if self.fs is None:
+            raise ValueError("engine swap needs a durable cluster")
+        if engine == "ssd":
+            from ..storage.btree import BTreeKeyValueStore as target_cls
+        else:
+            from ..storage.kvstore import DurableMemoryKeyValueStore as target_cls
+        cc = self.controller
+        if any(len(team) < 2 for team in cc.storage_teams_tags):
+            raise ValueError(
+                "engine swap needs replication >= 2: the migrating "
+                "replica's data is re-fetched from live teammates"
+            )
+        self.storage_engine = engine
+        for tag in [t for team in cc.storage_teams_tags for t in team]:
+            old = cc._tag_to_ss.get(tag)
+            if old is None or type(old.store) is target_cls:
+                continue  # already on the target engine (resume path)
+            old.process.kill()
+            testcov("configure.engine_replica_killed")
+
+            async def healed(tag=tag, old=old) -> None:
+                while True:
+                    cur = cc._tag_to_ss.get(tag)
+                    if (
+                        cur is not None and cur is not old
+                        and cur.process.alive
+                        and type(cur.store) is target_cls
+                    ):
+                        return
+                    await self.loop.delay(0.1, TaskPriority.COORDINATION)
+
+            # bounded: a wedged heal must surface as a failed swap the
+            # next conf poll resumes, not hang the engine step forever
+            t = self.loop.spawn(
+                healed(), TaskPriority.COORDINATION, f"engine-heal-{tag}"
+            )
+            try:
+                await timeout_error(self.loop, t, 60.0)
+            except TimedOut:
+                t.cancel()
+                raise
+        self._engine_applied = engine
+        testcov("configure.engine_converged")
+        self.trace.trace(
+            "StorageEngineSwapped", Engine=engine,
+            Replicas=len(cc.storage),
+        )
 
     def remote_database(self) -> Database:
         """A client view whose READS route to the remote region's replicas

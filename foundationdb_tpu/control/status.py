@@ -194,7 +194,8 @@ def cluster_status(cluster) -> dict[str, Any]:
         ],
         "tlogs": [
             {"version": t.version.get(), "bytes_queued": t.bytes_queued,
-             "locked": t.locked, "spill_events": getattr(t, "spill_events", 0)}
+             "locked": t.locked, "spill_events": getattr(t, "spill_events", 0),
+             "commits_refused": getattr(t, "commits_refused", 0)}
             for t in tlogs
         ],
         "storage": [
@@ -203,6 +204,7 @@ def cluster_status(cluster) -> dict[str, Any]:
                 "version": ss.version.get(),
                 "durable_version": ss.durable_version,
                 "keys": ss.store.key_count(),
+                "queue_bytes": getattr(ss, "queue_bytes", 0),
                 "read_latency": ss.read_latency.snapshot(),
                 # ssd engine only: page-cache accounting (AsyncFileCached)
                 **(
@@ -267,6 +269,14 @@ def cluster_status(cluster) -> dict[str, Any]:
 
     rk = getattr(cluster, "ratekeeper", None)
     doc["cluster"]["messages"] = _messages(trace, rk) + _device_messages(resolvers)
+
+    # -- per-disk gauges (storage/files.py fault plane) ----------------------
+    # bytes used vs capacity, degraded-mode multiplier, stall/error/ENOSPC
+    # counters: the operator's which-disk-is-melting table (the runbook's
+    # first read when ratekeeper says free_space / e_brake)
+    fs = getattr(cluster, "fs", None)
+    if fs is not None:
+        doc["cluster"]["disks"] = fs.disk_usage()
 
     dd = getattr(cluster, "dd", None)
     if dd is not None:
@@ -380,6 +390,10 @@ STATUS_SCHEMA: dict = {
             "devices?": dict, "device_transitions?": int,
         },
         "stream_consumers?": list,
+        # per-disk gauges (storage/files.py SimFilesystem.disk_usage):
+        # path -> {bytes_used, capacity, latency_mult, stalled, ops, syncs,
+        # stalls, errors_injected, enospc_errors, corrupt_reads, sync_s}
+        "disks?": dict,
         "regions?": {
             "usable_regions": int,
             "satellite": str,
@@ -401,11 +415,12 @@ STATUS_SCHEMA: dict = {
         {"version": int, "oldest_version": int, "latency": _LATENCY_SPEC}
     ],
     "tlogs": [
-        {"version": int, "bytes_queued": int, "locked": bool, "spill_events": int}
+        {"version": int, "bytes_queued": int, "locked": bool,
+         "spill_events": int, "commits_refused": int}
     ],
     "storage": [
         {"tag": str, "version": int, "durable_version": int, "keys": int,
-         "read_latency": _LATENCY_SPEC}
+         "queue_bytes": int, "read_latency": _LATENCY_SPEC}
     ],
     "latency_bands": {
         "commit": _LATENCY_SPEC,
@@ -481,9 +496,15 @@ STATUS_SCHEMA: dict = {
     "profiler?": {"busy_s_by_priority": dict, "slow_tasks": int},
     "ratekeeper?": {
         "tps_budget": (int, float),
+        "batch_tps_budget": (int, float),
         "limit_reason": str,
         "limiting_server": (str, type(None)),
+        "e_brake": bool,
         "storage_lag_smoothed": dict,
+        # keyed by tag (storage) / `tlogN` slot name (tlogs) — the
+        # ratekeeper status test pins the key shapes
+        "storage_queue_smoothed": dict,
+        "free_space": dict,
         "tlog_queue_smoothed": dict,
     },
 }
@@ -545,6 +566,7 @@ ROLE_METRICS_SCHEMA: dict = {
         "DurableVersion": int,
         "KnownCommitted": int,
         "Keys": int,
+        "QueueBytes": int,
         "ReadsPerSec": _NUM,
         "MutationsPerSec": _NUM,
         "ReadP99Ms": _NUM,

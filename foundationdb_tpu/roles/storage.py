@@ -40,6 +40,7 @@ from .types import (
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
 from ..runtime.buggify import maybe_delay
+from ..runtime.coverage import testcov
 from ..runtime.core import BrokenPromise, EventLoop, TaskPriority, TimedOut
 from ..runtime.metrics import LatencyTracker
 from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
@@ -333,6 +334,14 @@ class StorageServer:
         self.c_reads = self.counters.counter("reads")
         self.c_selector_reads = self.counters.counter("selector_reads")
         self.c_mutations = self.counters.counter("mutations_applied")
+        self.c_io_errors = self.counters.counter("io_errors")
+        # bytes applied above the durable version (the reference's
+        # bytesInput - bytesDurable storage queue): ratekeeper's
+        # storage_queue spring input.  Kept as a per-version ledger so the
+        # durability advance and rollbacks subtract exactly what they
+        # retire.
+        self.queue_bytes = 0
+        self._qbytes: list[tuple[Version, int]] = []
         self._metrics_emitter = None
         self.getvalue_stream = RequestStream(process, self.WLT_GETVALUE, unique=True)
         self.getkv_stream = RequestStream(process, self.WLT_GETKEYVALUES, unique=True)
@@ -404,8 +413,13 @@ class StorageServer:
                 if version <= self.version.get():
                     continue
                 live = self._route_fetching(version, muts) if self._fetching else muts
+                nb = 0
                 for m in live:
                     self.overlay.apply(version, m, self.store.get)
+                    nb += len(m.key) + len(m.value or b"")
+                if nb:
+                    self._qbytes.append((version, nb))
+                    self.queue_bytes += nb
                 self.c_mutations.add(len(live))
                 self.version.set(version)
                 self._fetched = version
@@ -623,16 +637,36 @@ class StorageServer:
             # store cannot un-flush (knownCommittedVersion bound)
             flush_to = min(target - window, self.known_committed)
             if flush_to > self.durable_version:
-                self.overlay.forget_before(
-                    flush_to, self.store.set, self.store.clear_range
-                )
-                commit = getattr(self.store, "commit", None)
-                if commit is not None:
-                    # disk engine: fsync the flushed batch (+ the durable
-                    # version marker) BEFORE popping the TLog — the TLog is
-                    # the only other copy of this data
-                    await commit({"durable_version": flush_to})
+                try:
+                    self.overlay.forget_before(
+                        flush_to, self.store.set, self.store.clear_range
+                    )
+                    commit = getattr(self.store, "commit", None)
+                    if commit is not None:
+                        # disk engine: fsync the flushed batch (+ the durable
+                        # version marker) BEFORE popping the TLog — the TLog is
+                        # the only other copy of this data
+                        await commit({"durable_version": flush_to})
+                except IOError:
+                    # the disk refused (ENOSPC / injected fault) or the
+                    # process was io_timeout-killed mid-sync: nothing
+                    # durable is claimed — the durable version holds, the
+                    # TLog keeps its copy, and the queue grows until
+                    # ratekeeper's free-space / queue-byte inputs squeeze
+                    # admission.  The engines keep memory and WAL atomic
+                    # per mutation (log-push-first), so a retry next tick
+                    # resumes exactly where the fault struck.
+                    self.c_io_errors.add(1)
+                    testcov("storage.durability_io_error")
+                    await self.loop.delay(0.25, TaskPriority.STORAGE_SERVER)
+                    continue
                 self.durable_version = flush_to  # flowlint: ok check-then-act-across-await (single-writer: the one _durability task owns durable_version; freeze/unfreeze never runs two)
+                i = 0
+                while i < len(self._qbytes) and self._qbytes[i][0] <= flush_to:
+                    self.queue_bytes -= self._qbytes[i][1]
+                    i += 1
+                if i:
+                    del self._qbytes[:i]
                 if self.tlog_pop is not None:
                     self.tlog_pop.send(TLogPopRequest(self.tag, flush_to))
 
@@ -903,6 +937,9 @@ class StorageServer:
             self.overlay.rollback_to(recovery_version)
             self.version.rollback(recovery_version)
             self._fetched = recovery_version
+            # rolled-back versions leave the queue ledger too
+            while self._qbytes and self._qbytes[-1][0] > recovery_version:
+                self.queue_bytes -= self._qbytes.pop()[1]
 
     def start_metrics(self, trace, interval: float):
         """Periodic StorageMetrics emission (the reference's StorageMetrics
@@ -918,6 +955,7 @@ class StorageServer:
                 "DurableVersion": self.durable_version,
                 "KnownCommitted": self.known_committed,
                 "Keys": self.store.key_count(),
+                "QueueBytes": self.queue_bytes,
                 "ReadsPerSec": r.get("reads", 0.0),
                 "MutationsPerSec": r.get("mutations_applied", 0.0),
                 "ReadP99Ms": self.read_latency.snapshot()["p99"] * 1e3,

@@ -41,7 +41,12 @@ from ..rpc.stream import RequestStream
 from ..runtime.buggify import buggify, maybe_delay
 from ..runtime.core import EventLoop, TaskPriority
 from ..runtime.coverage import testcov
-from ..runtime.trace import CounterCollection, g_trace_batch, spawn_role_metrics
+from ..runtime.trace import (
+    SEV_WARN,
+    CounterCollection,
+    g_trace_batch,
+    spawn_role_metrics,
+)
 from ..runtime.serialize import (
     BinaryReader,
     BinaryWriter,
@@ -104,10 +109,20 @@ class TLog:
                  initial_tags: dict | None = None,
                  known_committed: Version = 0,
                  disk_queue=None,
-                 spill_bytes: int = 1 << 22) -> None:
+                 spill_bytes: int = 1 << 22,
+                 hard_limit_bytes: int = 0,
+                 trace=None) -> None:
         self.loop = loop
         self.process = process
         self.sync_delay = sync_delay
+        # queue hard limit (TLOG_HARD_LIMIT_BYTES; 0 = unbounded): past it
+        # commits are REFUSED with a traced SEV_WARN — never silently
+        # acked, never allowed to grow the queue without bound.  The
+        # refusal is loud by contract: ratekeeper's e-brake exists to stop
+        # admission before this line, so crossing it is an operator event.
+        self.hard_limit_bytes = hard_limit_bytes
+        self.trace = trace
+        self.commits_refused = 0
         self.version = NotifiedVersion(start_version)
         # this epoch's floor: versions at or below it predate this TLog and
         # were NEVER stored here — the duplicate-ack path must refuse them
@@ -140,6 +155,12 @@ class TLog:
             for tag, entries in self._tags.items()
         }
         self._spilled: dict[str, list[tuple[Version, int, int]]] = {}
+        # commits between push and sync-return: the pop-side compaction
+        # must never truncate while one is in flight — the truncate drops
+        # the buffered record, yet that commit's sync() would still return
+        # success and ACK data the disk no longer holds (a rewrite-vs-
+        # group-commit race found while building the disk fault plane)
+        self._commits_syncing = 0
         seed_bytes = sum(
             n for offs in self._mem_offs.values() for _v, _o, n in offs
         )
@@ -150,8 +171,18 @@ class TLog:
             # frame the starting state; durable after initial_durable()/first
             # commit sync.  Callers must not delete the data's previous home
             # until then (controller awaits initial_durable before
-            # WRITING_CSTATE).
-            self.dq.push(_encode_reset(start_version, known_committed, self._tags))
+            # WRITING_CSTATE).  A transient injected disk error on this ONE
+            # push must not fail the whole recruitment — retry; a disk that
+            # persistently refuses does fail it (the controller recruits
+            # elsewhere / retries the recovery).
+            reset = _encode_reset(start_version, known_committed, self._tags)
+            for attempt in range(3):
+                try:
+                    self.dq.push(reset)
+                    break
+                except IOError:
+                    if attempt == 2:
+                        raise
         self._poppable: dict[str, Version] = {}
         self.counters = CounterCollection("TLog")
         self.c_commits = self.counters.counter("commits")
@@ -196,16 +227,54 @@ class TLog:
             # duplicate push (proxy retry): already logged, ack again
             req.reply(r.version)
             return
+        if self.hard_limit_bytes and self._live_bytes >= self.hard_limit_bytes:
+            # queue hard limit: refuse LOUDLY, never ack.  The proxy's push
+            # times out and escalates through the ordinary commit-path
+            # machinery (retry → UNKNOWN → recovery); what must never
+            # happen is an ack for data the queue cannot responsibly hold.
+            self.commits_refused += 1
+            testcov("tlog.hard_limit_refused")
+            if self.trace is not None:
+                self.trace.trace(
+                    "TLogCommitRefused", severity=SEV_WARN,
+                    track_latest=f"tlog-hard-limit-{self.process.name}",
+                    Process=self.process.name, Version=r.version,
+                    BytesQueued=self._live_bytes,
+                    HardLimit=self.hard_limit_bytes,
+                )
+            return
         # Sync BEFORE publishing: peek/lock must never serve data that was
         # not acked durable, or storage applies versions above the eventual
         # recovery version (phantom mutations of UNKNOWN-result txns).
         rec_off = -1
         if self.dq is not None:
             w = BinaryWriter().u8(_R_COMMIT).i64(r.known_committed)
-            rec_off = self.dq.push(
-                w.data() + encode_version_mutations(r.version, r.mutations_by_tag)
-            )
-            await self.dq.sync()  # the fsync (group-commits buffered peers)
+            try:
+                self._commits_syncing += 1
+                try:
+                    rec_off = self.dq.push(
+                        w.data()
+                        + encode_version_mutations(r.version, r.mutations_by_tag)
+                    )
+                    await self.dq.sync()  # the fsync (group-commits buffered peers)
+                finally:
+                    self._commits_syncing -= 1
+            except IOError as e:
+                # the disk refused (ENOSPC / injected error) or the process
+                # was io_timeout-killed mid-sync: the data is NOT durable,
+                # so never ack — refuse loudly and let the proxy's retry /
+                # recovery machinery handle it.  A silent ack here is the
+                # acked-data-loss hole the negative durability tests pin.
+                self.commits_refused += 1
+                testcov("tlog.disk_error_refused")
+                if self.trace is not None and self.process.alive:
+                    self.trace.trace(
+                        "TLogDiskError", severity=SEV_WARN,
+                        track_latest=f"tlog-disk-error-{self.process.name}",
+                        Process=self.process.name, Version=r.version,
+                        Error=repr(e),
+                    )
+                return
         elif self.sync_delay:
             await self.loop.delay(self.sync_delay, TaskPriority.TLOG_COMMIT)
         if self.locked:
@@ -337,31 +406,41 @@ class TLog:
                     self._live_bytes -= sum(n for _v, _o, n in sp[:j])
                     self._spilled[r.tag] = sp[j:]
             if self.dq is not None:
-                # lazily durable: a lost POP record only means re-serving
-                # already-durable data after a crash (storage dedups by
-                # version), so no sync here
-                self.dq.push(
-                    BinaryWriter().u8(_R_POP).str_(r.tag).i64(r.upto_version).data()
-                )
-                if (
-                    self.dq.bytes_pushed > 4 * max(self._live_bytes, 1) + (1 << 20)
-                    and not any(self._spilled.values())
-                ):
-                    # a rewrite invalidates every recorded record offset, so
-                    # it only runs with nothing spilled, and the surviving
-                    # in-memory entries become unspillable (their payloads
-                    # now live only inside the fresh RESET blob)
-                    self.dq.rewrite(
-                        [
-                            _encode_reset(
-                                self.version.get(), self.known_committed, self._tags
-                            )
-                        ]
+                try:
+                    # lazily durable: a lost POP record only means re-serving
+                    # already-durable data after a crash (storage dedups by
+                    # version), so no sync here
+                    self.dq.push(
+                        BinaryWriter().u8(_R_POP).str_(r.tag).i64(r.upto_version).data()
                     )
-                    self._mem_offs = {
-                        tag: [(v, -1, n) for v, _o, n in offs]
-                        for tag, offs in self._mem_offs.items()
-                    }
+                    if (
+                        self.dq.bytes_pushed > 4 * max(self._live_bytes, 1) + (1 << 20)
+                        and not any(self._spilled.values())
+                        and self._commits_syncing == 0
+                    ):
+                        # a rewrite invalidates every recorded record offset, so
+                        # it only runs with nothing spilled, and the surviving
+                        # in-memory entries become unspillable (their payloads
+                        # now live only inside the fresh RESET blob)
+                        self.dq.rewrite(
+                            [
+                                _encode_reset(
+                                    self.version.get(), self.known_committed, self._tags
+                                )
+                            ]
+                        )
+                        self._mem_offs = {
+                            tag: [(v, -1, n) for v, _o, n in offs]
+                            for tag, offs in self._mem_offs.items()
+                        }
+                except IOError:
+                    # the disk refused the pop record / the compaction
+                    # (fault plane): pops are advisory and the rewrite
+                    # un-journaled itself — a reboot merely re-serves
+                    # already-popped durable data, which storage dedups.
+                    # What must NOT happen is the serve loop dying: a TLog
+                    # that silently stops serving pops never trims again.
+                    testcov("tlog.pop_io_error")
             req.reply(None)
 
     # -- lock (recovery) ----------------------------------------------------
@@ -394,9 +473,18 @@ class TLog:
         """Await durability of the construction-time RESET record.  A new
         generation's seeds (the surviving data of the previous epoch) must
         hit this TLog's disk before the old epoch's files/processes may be
-        discarded (controller awaits this before WRITING_CSTATE)."""
+        discarded (controller awaits this before WRITING_CSTATE).  Retries
+        transient injected disk errors — failing recovery over one 5%-coin
+        fault would make every chaos seed a boot lottery."""
         if self.dq is not None:
-            await self.dq.sync()
+            for attempt in range(3):
+                try:
+                    await self.dq.sync()
+                    return
+                except IOError:
+                    if attempt == 2 or not self.process.alive:
+                        raise
+                    await self.loop.delay(0.02, TaskPriority.TLOG_COMMIT)
 
     @staticmethod
     def recover_state(dq) -> tuple[Version, Version, dict[str, list]]:

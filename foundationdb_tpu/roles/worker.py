@@ -192,7 +192,11 @@ class Worker:
                 dq = DiskQueue(self.fs.open(p["path"], proc))
             t = TLog(proc, loop, start_version=p["start_version"],
                      initial_tags=p["seeds"], known_committed=p["known_committed"],
-                     disk_queue=dq, spill_bytes=self.knobs.TLOG_SPILL_BYTES)
+                     disk_queue=dq, spill_bytes=self.knobs.TLOG_SPILL_BYTES,
+                     hard_limit_bytes=self.knobs.TLOG_HARD_LIMIT_BYTES,
+                     # the cluster assembly binds its collector to the fs
+                     # (workers have no trace handle of their own)
+                     trace=getattr(self.fs, "trace", None))
             return t, {
                 "commit": t.commit_stream.endpoint,
                 "peek": t.peek_stream.endpoint,

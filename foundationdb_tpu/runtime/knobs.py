@@ -150,6 +150,31 @@ class CoreKnobs(Knobs):
         # smoothing time constant for the ratekeeper's per-server model and
         # published budget (reference SMOOTHING_AMOUNT, Knobs.cpp)
         self.init("RATEKEEPER_SMOOTHING_E", 1.0)
+        # -- resource-exhaustion plane (docs/OPERATIONS.md "Disk pressure")
+        # TLog queue hard limit (reference TLOG_HARD_LIMIT_BYTES): past it
+        # the TLog REFUSES commits loudly (SEV_WARN TLogCommitRefused,
+        # never a silent ack) instead of growing without bound; ratekeeper
+        # e-brakes admission before a healthy cluster ever reaches it —
+        # which needs HEADROOM above TARGET_QUEUE_BYTES (1<<27): the
+        # spring must have squeezed long before the refusal line
+        self.init("TLOG_HARD_LIMIT_BYTES", 1 << 28)
+        # storage queue-byte spring (reference TARGET_BYTES_PER_STORAGE_
+        # SERVER / STORAGE_HARD_LIMIT_BYTES): smoothed bytes-in-queue per
+        # storage server squeeze admission toward the target; crossing the
+        # hard limit slams the e-brake
+        self.init("TARGET_STORAGE_QUEUE_BYTES", 1 << 26)
+        self.init("STORAGE_HARD_LIMIT_BYTES", 1 << 27)
+        # free-space limiting (reference storage_server_min_free_space):
+        # admission squeezes proportionally once a storage disk's free
+        # fraction drops below the target, and the e-brake engages at the
+        # minimum — commits stop before the disk physically fills
+        self.init("FREE_SPACE_TARGET_FRACTION", 0.25)
+        self.init("MIN_FREE_SPACE_FRACTION", 0.05)
+        # io_timeout fail-fast (reference io_timeout / MAX_STORAGE_COMMIT_
+        # TIME): a disk sync stalled past this many virtual seconds KILLS
+        # the owning process through the ordinary kill/recovery machinery
+        # rather than wedging the commit plane (storage/files.py)
+        self.init("IO_TIMEOUT_S", 5.0)
 
         # device supervisor (conflict/supervisor.py): the DEFAULT_BACKOFF
         # family applied to the hardware conflict backend.  Every device

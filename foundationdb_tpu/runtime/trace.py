@@ -42,6 +42,15 @@ WARN_EVENT_TYPES = frozenset({
     "BlobRequestRetried",        # storage/blobstore.py: one blob-store
                                  # retry (backoff in flight); soak triage
                                  # summarizes retry storms per seed
+    "IoTimeoutKilled",           # storage/files.py: a disk sync stalled
+                                 # past IO_TIMEOUT_S fail-fasted its
+                                 # process (kill/recovery takes over)
+    "TLogCommitRefused",         # roles/tlog.py: queue past
+                                 # TLOG_HARD_LIMIT_BYTES — commit refused,
+                                 # never silently acked
+    "TLogDiskError",             # roles/tlog.py: the durable log's disk
+                                 # refused (ENOSPC/injected error); the
+                                 # push is unacked and the proxy escalates
 })
 
 

@@ -122,9 +122,18 @@ class SimFSBacking:
         p = self._p(path)
         self.fs.delete(p)  # objects are immutable; a rewrite replaces
         f = self.fs.open(p, None)
-        f.append(data)
-        await f.sync()
-        f.close()
+        try:
+            f.append(data)
+            await f.sync()
+        except IOError as e:
+            # the store's own disk refused (the disk fault plane —
+            # injected error/ENOSPC/stall-kill): to the blob CLIENT this
+            # is a transient backend failure like any 5xx, and its
+            # backoff/retry budget owns it; a half-written object is
+            # invisible (the meta record is the commit point)
+            raise BlobTransientError(f"backing disk: {e}") from e
+        finally:
+            f.close()
 
     async def read(self, path: str) -> bytes | None:
         p = self._p(path)

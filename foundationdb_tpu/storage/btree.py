@@ -255,6 +255,23 @@ class BTreeKeyValueStore:
             target -= n
         return None
 
+    def disk_usage(self) -> tuple[int, int | None]:
+        """(bytes used, capacity|None) — the fullest of this store's disks
+        (data files + header), the free-space input ratekeeper reads.  The
+        capacitated disk closest to full wins; with no capacity anywhere,
+        total usage with None."""
+        paths = [f.path for f in self._files] + [self._hdr.file.path]
+        worst: tuple[int, int | None] | None = None
+        total = 0
+        for p in paths:
+            used, cap = self._fs.usage_for(p)
+            total += used
+            if cap is not None and (
+                worst is None or used * (worst[1] or 1) > worst[0] * cap
+            ):
+                worst = (used, cap)
+        return worst if worst is not None else (total, None)
+
     # ---- commit -------------------------------------------------------------
     async def commit(self, meta: dict[str, int] | None = None) -> None:
         if meta:
@@ -378,12 +395,21 @@ class BTreeKeyValueStore:
             return hit
         self.cache_misses += 1
         f = self._files[self._file_id]
-        head = f.pread(off, 8)
-        r = BinaryReader(head)
-        ln, crc = r.u32(), r.u32()
-        body = f.pread(off + 8, ln)
-        if len(body) != ln or (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-            raise IOError(f"btree page corrupt at {self._path}[{off}]")
+        # checksum mismatches are retried once: the sim's corrupt-on-read
+        # fault (disk.corrupt_read) is a transient media error; only a
+        # second failure means the page is really gone
+        for attempt in (0, 1):
+            head = f.pread(off, 8)
+            r = BinaryReader(head)
+            ln, crc = r.u32(), r.u32()
+            body = f.pread(off + 8, ln)
+            if len(body) == ln and (zlib.crc32(body) & 0xFFFFFFFF) == crc:
+                break
+            if attempt == 1:
+                raise IOError(f"btree page corrupt at {self._path}[{off}]")
+            from ..runtime.coverage import testcov
+
+            testcov("disk.btree_corrupt_read_retried")
         r = BinaryReader(body)
         kind, n = r.u8(), r.u32()
         keys, vals = [], []

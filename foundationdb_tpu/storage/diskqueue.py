@@ -46,15 +46,26 @@ class DiskQueue:
     def read_at(self, off: int) -> bytes:
         """Re-read one record by the offset push() returned (spilled-entry
         fetch).  Offsets are invalidated by rewrite() — callers must not
-        hold them across a rewrite."""
-        head = self.file.pread(off, _HEADER.size)
-        if len(head) < _HEADER.size:
-            raise IOError(f"diskqueue short read at {off}")
-        magic, ln, crc = _HEADER.unpack(head)
-        payload = self.file.pread(off + _HEADER.size, ln)
-        if magic != _MAGIC or len(payload) != ln or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-            raise IOError(f"diskqueue record corrupt at {off}")
-        return payload
+        hold them across a rewrite.
+
+        A checksum mismatch is retried once: the sim's corrupt-on-read
+        fault (`disk.corrupt_read`, files.py) is a transient media error a
+        real engine heals by re-reading; only a SECOND failure — the data
+        is really gone — raises."""
+        for attempt in (0, 1):
+            head = self.file.pread(off, _HEADER.size)
+            if len(head) < _HEADER.size:
+                raise IOError(f"diskqueue short read at {off}")
+            magic, ln, crc = _HEADER.unpack(head)
+            if magic == _MAGIC:
+                payload = self.file.pread(off + _HEADER.size, ln)
+                if len(payload) == ln and (zlib.crc32(payload) & 0xFFFFFFFF) == crc:
+                    return payload
+            if attempt == 0:
+                from ..runtime.coverage import testcov
+
+                testcov("disk.corrupt_read_retried")
+        raise IOError(f"diskqueue record corrupt at {off}")
 
     async def sync(self) -> None:
         await self.file.sync()
@@ -64,11 +75,22 @@ class DiskQueue:
         JOURNALED (files.SimFile.truncate): the old synced contents stay
         recoverable until the next successful sync() makes the replacement
         durable, so a crash in the window recovers the pre-compaction log —
-        never an empty file."""
+        never an empty file.  A push REFUSED mid-rewrite (disk fault
+        plane: ENOSPC/injected error) un-journals the truncate before
+        re-raising — otherwise the next sync would land the truncate with
+        the replacement records missing, destroying the durable log.
+        Records partially pushed before the failure stay appended after
+        the old contents; every rewrite consumer's record vocabulary is
+        snapshot-style (RESET/SNAPSHOT resets state on replay), so a
+        recovered old-log + partial-replacement sequence reads correctly."""
         self.file.truncate()
         self.bytes_pushed = 0
-        for r in records:
-            self.push(r)
+        try:
+            for r in records:
+                self.push(r)
+        except IOError:
+            self.file.cancel_truncate()
+            raise
 
     # -- recovery -----------------------------------------------------------
     def recover(self, include_unsynced: bool = False) -> list[bytes]:

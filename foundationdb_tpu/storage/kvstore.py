@@ -38,16 +38,22 @@ class DurableMemoryKeyValueStore(MemoryKeyValueStore):
         self._snapshot_threshold = 1 << 20
 
     # -- mutations (logged) --------------------------------------------------
+    # Log push comes FIRST, memory mutation second: an append refused by
+    # the disk's fault plane (ENOSPC / injected error, storage/files.py)
+    # must leave the in-memory map and the WAL agreeing — a mutation in
+    # memory but not in the log would survive in served reads yet vanish
+    # at the next crash, exactly the silent acked-data-loss shape the
+    # resource-exhaustion campaign exists to rule out.
     def set(self, key: bytes, value: bytes) -> None:
-        super().set(key, value)
         w = BinaryWriter().u8(_SET).bytes_(key).bytes_(value)
         self._dq.push(w.data())
+        super().set(key, value)
         self._since_snapshot += len(key) + len(value)
 
     def clear_range(self, begin: bytes, end: bytes) -> None:
-        super().clear_range(begin, end)
         w = BinaryWriter().u8(_CLEAR).bytes_(begin).bytes_(end)
         self._dq.push(w.data())
+        super().clear_range(begin, end)
         self._since_snapshot += len(begin) + len(end)
 
     async def commit(self, meta: dict[str, int] | None = None) -> None:
@@ -68,6 +74,12 @@ class DurableMemoryKeyValueStore(MemoryKeyValueStore):
 
     def _data_bytes(self) -> int:
         return sum(len(k) + len(v) for k, v in self._data.items())
+
+    def disk_usage(self) -> tuple[int, int | None]:
+        """(bytes used, capacity|None) of the WAL's disk — the free-space
+        input ratekeeper's storage_server_min_free_space analog reads."""
+        f = self._dq.file
+        return f._fs.usage_for(f.path)
 
     def _write_snapshot(self) -> None:
         w = BinaryWriter().u8(_SNAPSHOT)
@@ -119,6 +131,16 @@ class DurableMemoryKeyValueStore(MemoryKeyValueStore):
         # between push and the commit marker)
         store.meta = dict(committed_meta)
         # re-log the recovered state as a fresh snapshot so the log and the
-        # in-memory map agree again (uncommitted tail is physically dropped)
-        store._write_snapshot()
+        # in-memory map agree again (uncommitted tail is physically dropped
+        # — it MUST be: a later commit marker would otherwise resurrect it
+        # on the next replay).  Transient injected disk faults are retried;
+        # the journaled truncate un-winds itself between attempts, so the
+        # old log stays recoverable throughout.
+        for attempt in range(3):
+            try:
+                store._write_snapshot()
+                break
+            except IOError:
+                if attempt == 2:
+                    raise
         return store

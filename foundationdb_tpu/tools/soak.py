@@ -239,6 +239,13 @@ def triage_seed(events: list[dict[str, Any]], spec_path: str,
         if e.get("Severity", 0) >= SEV_WARN and e.get("Type") != "CodeCoverage"
     ]
     warns.sort(key=lambda e: (e.get("WallTime", 0.0), e.get("Time", 0.0)))
+    # errors lead: a chaos-heavy seed can emit dozens of legitimate
+    # SEV_WARN fault events (disk refusals, ratekeeper transitions) before
+    # the one SEV_ERROR that says why it DIED — the why must never be
+    # crowded out of the block
+    errors = [e for e in warns if e.get("Severity", 0) >= SEV_ERROR]
+    lead = errors[:max_events]
+    lead += [e for e in warns if e not in lead][: max_events - len(lead)]
     first = [
         {
             "Type": e.get("Type"),
@@ -251,7 +258,7 @@ def triage_seed(events: list[dict[str, Any]], spec_path: str,
                              "WallTime", "File")
             },
         }
-        for e in warns[:max_events]
+        for e in lead
     ]
     slow = trace_tool.top_slow(events, 1)
     return {
@@ -298,14 +305,40 @@ def _child_env() -> dict:
     return env
 
 
+def _prune_artifacts(adir: str) -> None:
+    """Drop a PASSING seed's bulky artifacts (trace files, restart
+    images) but keep `result.json` — it now carries the seed's census,
+    which is everything a `--resume` of the campaign needs to count this
+    seed as done without re-running it."""
+    for entry in os.listdir(adir):
+        if entry == "result.json":
+            continue
+        p = os.path.join(adir, entry)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
 def run_campaign(spec_path: str, seeds: list[int], outdir: str,
                  jobs: int = 0, seed_deadline: float = 300.0,
                  sim_deadline: float = 900.0, sample_rate: float = 1.0,
                  required: list[str] | None = None,
                  keep_traces: bool = False,
+                 resume: bool = False,
                  progress=None) -> dict:
     """Run the campaign, aggregate, write campaign.json + campaign.md
-    under `outdir`, return the report dict."""
+    under `outdir`, return the report dict.
+
+    `resume=True` is the checkpoint/restart path for big campaigns: any
+    seed whose artifact dir already holds a parseable `result.json` with
+    a completed verdict (pass/fail — a run that finished and said so) is
+    adopted instead of re-run; only seeds with no verdict (never ran,
+    timed out, crashed, or died mid-write) are launched.  A 1000-seed
+    campaign killed at seed 700 restarts from 700, not 0."""
     from . import trace_tool
 
     if not seeds:
@@ -324,6 +357,29 @@ def run_campaign(spec_path: str, seeds: list[int], outdir: str,
     running: dict[int, tuple[subprocess.Popen, float, Any]] = {}
     results: dict[int, dict] = {}
     t_campaign = time.time()
+
+    if resume:
+        still: list[int] = []
+        for seed in pending:
+            res_path = os.path.join(outdir, f"seed-{seed}", "result.json")
+            prior = None
+            try:
+                with open(res_path) as f:
+                    prior = json.load(f)
+            except (OSError, ValueError):
+                pass
+            if (
+                prior is not None
+                and prior.get("seed") == seed
+                and prior.get("verdict") in ("pass", "fail")
+            ):
+                # a completed verdict: adopt it.  timeout/crash rows never
+                # wrote one (the PARENT classifies those), so they re-run.
+                results[seed] = prior
+                say(f"seed {seed}: resumed ({prior['verdict']})")
+            else:
+                still.append(seed)
+        pending = still
 
     def launch(seed: int) -> None:
         adir = os.path.join(outdir, f"seed-{seed}")
@@ -384,18 +440,37 @@ def run_campaign(spec_path: str, seeds: list[int], outdir: str,
     for seed in seeds:
         adir = os.path.join(outdir, f"seed-{seed}")
         events = trace_tool.load_events([adir]) if os.path.isdir(adir) else []
-        per_seed_census[seed] = census_from_events(events)
         r = results[seed]
-        n_retries = blob_retry_count(events)
+        if events:
+            census = census_from_events(events)
+        else:
+            # a resumed seed whose traces were already scraped-and-pruned:
+            # its census rode result.json (written below on first pass)
+            census = r.get("census") or {"buggify": {}, "testcov": {}}
+        per_seed_census[seed] = census
+        n_retries = blob_retry_count(events) if events else r.get(
+            "blob_retries", 0
+        )
         if n_retries:
             r["blob_retries"] = n_retries  # per-seed storm summary
         if r["verdict"] != "pass":
-            r["triage"] = triage_seed(events, spec_path, seed)
-        elif not keep_traces:
+            if events or "triage" not in r:
+                r["triage"] = triage_seed(events, spec_path, seed)
+        elif not keep_traces and os.path.isdir(adir):
             # passing seeds' traces are scraped-and-pruned to bound disk
-            # over 100-seed campaigns; failing seeds keep theirs for the
-            # repro/triage loop
-            shutil.rmtree(adir, ignore_errors=True)
+            # over 1000-seed campaigns; the census is folded into
+            # result.json FIRST so a later --resume still counts the seed,
+            # and failing seeds keep their traces for the repro/triage
+            # loop.  An already-folded result (a resumed seed) is left
+            # byte-identical — adoption must not touch it.
+            if r.get("census") != census:
+                r["census"] = census
+                try:
+                    with open(os.path.join(adir, "result.json"), "w") as f:
+                        json.dump(r, f, indent=2, default=str)
+                except OSError:
+                    pass
+            _prune_artifacts(adir)
 
     merged = merge_census(per_seed_census)
     missing = check_required(merged, required)
@@ -564,6 +639,10 @@ def main(argv: list[str] | None = None) -> int:
                          "<spec stem>.coverage next to the spec)")
     ap.add_argument("--keep-traces", action="store_true",
                     help="keep passing seeds' trace files too")
+    ap.add_argument("--resume", action="store_true",
+                    help="adopt seeds whose result.json already carries a "
+                         "completed verdict instead of re-running them (a "
+                         "killed campaign restarts where it died)")
     # internal: the child body for one seed
     ap.add_argument("--run-one", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--seed", type=int, default=None, help=argparse.SUPPRESS)
@@ -589,7 +668,7 @@ def main(argv: list[str] | None = None) -> int:
         args.spec, seeds, outdir, jobs=args.jobs,
         seed_deadline=args.seed_deadline, sim_deadline=args.sim_deadline,
         sample_rate=args.sample_rate, required=required,
-        keep_traces=args.keep_traces, progress=print,
+        keep_traces=args.keep_traces, resume=args.resume, progress=print,
     )
     print(f"\ncampaign {'OK' if report['ok'] else 'FAILED'}: "
           f"{report['verdicts']} — report in {outdir}/campaign.md")

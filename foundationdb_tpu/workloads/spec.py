@@ -63,6 +63,8 @@ from .conflict_range import ConflictRangeWorkload
 from .consistency import ConsistencyCheckWorkload
 from .cycle import CycleWorkload
 from .device_fault import DeviceFaultWorkload
+from .disk_swizzle import DiskSwizzleWorkload
+from .low_space import LowSpaceWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
 from .kill_region import KillRegionWorkload
@@ -89,6 +91,8 @@ WORKLOAD_FACTORY = {
     "Swizzle": SwizzleWorkload,
     "WriteDuringRead": WriteDuringReadWorkload,
     "DeviceFault": DeviceFaultWorkload,
+    "DiskSwizzle": DiskSwizzleWorkload,
+    "LowSpace": LowSpaceWorkload,
     "SelectorOracle": SelectorOracleWorkload,
     "SaveAndKill": SaveAndKillWorkload,
     "Rollback": RollbackWorkload,

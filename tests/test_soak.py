@@ -230,7 +230,11 @@ def test_soak_campaign_verdicts_census_and_triage(tmp_path, monkeypatch):
     assert "seed 3001 — fail" in md and "repro" in md
     assert "buggify site" in md and "testcov name" in md
     assert (out / "seed-3001").is_dir()
-    assert not (out / "seed-3000").exists()
+    # a passing seed keeps ONLY result.json (now carrying its census — the
+    # --resume checkpoint); its bulky trace files are pruned
+    assert not list((out / "seed-3000").glob("trace*"))
+    r3000 = json.loads((out / "seed-3000" / "result.json").read_text())
+    assert r3000["verdict"] == "pass" and r3000["census"]["testcov"]
 
 
 def test_soak_repro_command_reruns_the_failing_seed(tmp_path):
