@@ -424,7 +424,8 @@ def _init_backend() -> dict:
 
 def _emit(metric: str, value: float, vs_baseline: float, error: str | None = None,
           kernel: dict | None = None, commit_wire: dict | None = None,
-          metrics_series: dict | None = None) -> None:
+          metrics_series: dict | None = None,
+          page_cache: dict | None = None) -> None:
     doc = {
         "metric": metric,
         "value": round(value, 1),
@@ -433,6 +434,12 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
     }
     if error is not None:
         doc["error"] = error
+    if page_cache is not None:
+        # storage read-path trajectory (storage/pagecache.py): cold/warm
+        # range-scan pread counts through the ssd engine with the file-
+        # level page cache on vs off — the host-read-path counterpart of
+        # the commit_wire block
+        doc["page_cache"] = page_cache
     if kernel is not None:
         # kernel profiling counters (conflict/api.py KernelStats): the perf
         # trajectory future rounds regress against — padding occupancy,
@@ -449,6 +456,64 @@ def _emit(metric: str, value: float, vs_baseline: float, error: str | None = Non
         # samples over the run, not just an end-of-run snapshot
         doc["metrics_series"] = metrics_series
     print(json.dumps(doc))
+
+
+def _page_cache_probe(keys: int = 4000) -> dict | None:
+    """Measure the ssd engine's read path with the file-level page cache
+    on vs off (storage/pagecache.py): build one B-tree, then run a COLD
+    full-range scan (fresh recover, pool cleared — every parsed page
+    gone) followed by the same scan warm, counting the disk preads each
+    needed.  Simulated reads are instant, so the pread COUNT is the
+    honest measurable (the cold-range-read wall's proxy); the counters
+    also carry hit/miss/read-ahead attribution.  Pure CPU + sim clock —
+    safe on device and no-device runs alike, deterministic by seed."""
+    try:
+        from foundationdb_tpu.runtime.core import DeterministicRandom, EventLoop
+        from foundationdb_tpu.storage.btree import BTreeKeyValueStore
+        from foundationdb_tpu.storage.files import SimFilesystem
+        from foundationdb_tpu.storage.pagecache import PageCachePool
+
+        def scan_ops(fs, store) -> int:
+            ops0 = sum(fs.disk(p).reads for p in ("pc.a", "pc.b", "pc.hdr"))
+            rows = store.range_read(b"", b"\xff" * 8, 1 << 30)
+            assert len(rows) == keys
+            return sum(fs.disk(p).reads for p in ("pc.a", "pc.b", "pc.hdr")) - ops0
+
+        def one(cache_on: bool) -> dict:
+            loop = EventLoop()
+            fs = SimFilesystem(loop, DeterministicRandom(5))
+            if cache_on:
+                fs.page_pool = PageCachePool(4096, 1 << 20, 8)
+            store = BTreeKeyValueStore(fs, "pc", None, cache_bytes=1 << 14)
+
+            async def build():
+                for i in range(keys):
+                    store.set(b"k%06d" % i, b"v" * 64)
+                await store.commit({})
+
+            loop.run_until(loop.spawn(build()), 1e12)
+            # a fresh process lifetime: parsed cache empty, pool cold
+            if fs.page_pool is not None:
+                fs.page_pool.clear()
+            s2 = BTreeKeyValueStore.recover(fs, "pc", None,
+                                            cache_bytes=1 << 14)
+            cold = scan_ops(fs, s2)
+            warm = scan_ops(fs, s2)
+            out = {"cold_scan_preads": cold, "warm_scan_preads": warm}
+            out.update(s2.page_cache_stats())
+            return out
+
+        on, off = one(True), one(False)
+        return {
+            "keys": keys,
+            "cache_on": on,
+            "cache_off": off,
+            "cold_preads_saved": off["cold_scan_preads"] - on["cold_scan_preads"],
+            "warm_preads_saved": off["warm_scan_preads"] - on["warm_scan_preads"],
+        }
+    except Exception as e:  # noqa: BLE001 — the block is additive data
+        print(f"[bench] page cache probe failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def _metrics_series_probe(n_commits: int = 200) -> dict | None:
@@ -729,6 +794,7 @@ def _cpu_phase_main() -> None:
         "h2d_ms": round(e2e["h2d_ms"], 2),
         "resolver_e2e_checks_per_sec": round(e2e_rate, 1),
         "commit_wire": _commit_wire_probe(),
+        "page_cache": _page_cache_probe(),
     }))
 
 
@@ -804,10 +870,11 @@ def main() -> None:
         # process cannot be trusted to run jax).
         print(f"[bench] NO DEVICE BACKEND: {init.get('error')}", file=sys.stderr)
         kern = _cpu_phase_probe()
-        # the cpu-phase subprocess already measured the wire probe under a
-        # clean JAX-CPU env; lift it to the top-level block (measure
-        # in-process only if that pass failed)
+        # the cpu-phase subprocess already measured the wire + page-cache
+        # probes under a clean JAX-CPU env; lift them to the top-level
+        # block (measure in-process only if that pass failed)
         wire = (kern or {}).pop("commit_wire", None) or _commit_wire_probe()
+        pcache = (kern or {}).pop("page_cache", None) or _page_cache_probe()
         _emit(
             "occ_conflict_checks_per_sec_native_cpu_64k_live_ranges",
             native_rate,
@@ -816,6 +883,7 @@ def main() -> None:
             kernel=kern,
             commit_wire=wire,
             metrics_series=_metrics_series_probe(),
+            page_cache=pcache,
         )
         os._exit(0)  # daemon init thread may be wedged in PJRT; exit hard
     backend = init["backend"]
@@ -1082,6 +1150,7 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
         kernel=kernel,
         commit_wire=_commit_wire_probe(),
         metrics_series=_metrics_series_probe(),
+        page_cache=_page_cache_probe(),
     )
 
 

@@ -111,6 +111,11 @@ class RecoverableCluster:
                                 # clock WITH socket IO (rpc/transport.py
                                 # NetDriver) — required with external_cstate,
                                 # whose RPCs need the sockets pumped
+        knob_overrides: dict | None = None,  # name -> value applied via
+                                # set_knob AFTER knob construction (so it
+                                # composes with chaos randomization) — the
+                                # spec files' `knob.NAME=value` lines land
+                                # here, the reference's --knob_ path
     ) -> None:
         self.loop = loop or EventLoop()
         self.rng = DeterministicRandom(seed)
@@ -126,6 +131,8 @@ class RecoverableCluster:
             _buggify.disable()
             self.knobs = knobs or CoreKnobs()
             self.client_knobs = ClientKnobs()
+        for _kname, _kval in (knob_overrides or {}).items():
+            self.knobs.set_knob(_kname, str(_kval))
         self.trace = TraceCollector(
             clock=self.loop.now, sink=trace_sink,
             min_severity=self.knobs.TRACE_SEVERITY,
@@ -160,6 +167,20 @@ class RecoverableCluster:
             # the collector through fs.trace)
             self.fs.io_timeout_s = self.knobs.IO_TIMEOUT_S
             self.fs.trace = self.trace
+            # the shared file-level page cache (storage/pagecache.py):
+            # a FRESH pool per boot — cached pages belong to a process
+            # lifetime, never to the disks (a restart image or power-kill
+            # always comes back cold); PAGE_CACHE_BYTES=0 disables
+            if self.knobs.PAGE_CACHE_BYTES > 0:
+                from ..storage.pagecache import PageCachePool
+
+                self.fs.page_pool = PageCachePool(
+                    page_size=self.knobs.PAGE_CACHE_4K,
+                    capacity_bytes=self.knobs.PAGE_CACHE_BYTES,
+                    readahead_pages=self.knobs.READAHEAD_PAGES,
+                )
+            else:
+                self.fs.page_pool = None
 
         def splits(n: int) -> list[bytes]:
             return [bytes([256 * i // n]) for i in range(1, n)]
@@ -301,8 +322,8 @@ class RecoverableCluster:
                         f"{storage_engine!r} (an online engine swap "
                         f"preceded the save — boot with the disks' engine)"
                     )
-                return cls_.recover(self.fs, fname, p)
-            return cls_(self.fs, fname, p)
+                return cls_.recover(self.fs, fname, p, **self._store_kwargs())
+            return cls_(self.fs, fname, p, **self._store_kwargs())
 
         self.storage: list[StorageServer] = []
         for i in range(n_storage_shards):
@@ -454,10 +475,11 @@ class RecoverableCluster:
                 else:
                     path = f"ss{shard}r{rep}.kv"
                 if self.fs.exists(path if self.storage_engine != "ssd" else path + ".hdr"):
-                    return cls_.recover(self.fs, path, proc)
+                    return cls_.recover(self.fs, path, proc,
+                                        **self._store_kwargs())
                 for stale in (path, path + ".a", path + ".b", path + ".hdr"):
                     self.fs.delete(stale)
-                return cls_(self.fs, path, proc)
+                return cls_(self.fs, path, proc, **self._store_kwargs())
             return MemoryKeyValueStore()
 
         self.dd = DataDistributor(
@@ -730,6 +752,15 @@ class RecoverableCluster:
             Tags=[s.tag for s in self.remote_storage],
         )
 
+    def _store_kwargs(self) -> dict:
+        """Engine-specific store constructor kwargs: the ssd engine's
+        parsed-page cache budget rides the BTREE_CACHE_BYTES knob (the
+        saturation harness shrinks it to push reads down to the file
+        layer)."""
+        if self.storage_engine == "ssd":
+            return {"cache_bytes": self.knobs.BTREE_CACHE_BYTES}
+        return {}
+
     def _make_store_recover(self, fname: str, proc):
         """A store over `fname`, recovering the durable contents if the
         file exists (the region-reboot twin of __init__'s make_store)."""
@@ -744,8 +775,8 @@ class RecoverableCluster:
 
             probe = fname
         if self.fs.exists(probe):
-            return cls_.recover(self.fs, fname, proc)
-        return cls_(self.fs, fname, proc)
+            return cls_.recover(self.fs, fname, proc, **self._store_kwargs())
+        return cls_(self.fs, fname, proc, **self._store_kwargs())
 
     async def _enable_remote_region_online(self) -> None:
         """usable_regions 1→2 on a LIVE cluster: build the relay plane,

@@ -206,11 +206,19 @@ def cluster_status(cluster) -> dict[str, Any]:
                 "keys": ss.store.key_count(),
                 "queue_bytes": getattr(ss, "queue_bytes", 0),
                 "read_latency": ss.read_latency.snapshot(),
-                # ssd engine only: page-cache accounting (AsyncFileCached)
+                # ssd engine only: parsed-page cache accounting (kept for
+                # continuity; the structured block below carries the rest)
                 **(
                     {"cache_hits": ss.store.cache_hits,
                      "cache_misses": ss.store.cache_misses}
                     if hasattr(ss.store, "cache_hits") else {}
+                ),
+                # durable engines: the file-level page-cache counter block
+                # (storage/pagecache.py — hit/miss/read-ahead per store,
+                # plus the ssd engine's parsed-page cache gauges)
+                **(
+                    {"page_cache": ss.store.page_cache_stats()}
+                    if hasattr(ss.store, "page_cache_stats") else {}
                 ),
             }
             for ss in cluster.storage
@@ -277,6 +285,12 @@ def cluster_status(cluster) -> dict[str, Any]:
     fs = getattr(cluster, "fs", None)
     if fs is not None:
         doc["cluster"]["disks"] = fs.disk_usage()
+        # the SHARED page pool's gauges (one pool per process lifetime —
+        # byte budget, live bytes, evictions; per-store hit/miss counters
+        # live in the storage rows above)
+        pool = getattr(fs, "page_pool", None)
+        if pool is not None:
+            doc["cluster"]["page_cache"] = pool.stats()
 
     dd = getattr(cluster, "dd", None)
     if dd is not None:
@@ -394,6 +408,17 @@ STATUS_SCHEMA: dict = {
         # path -> {bytes_used, capacity, latency_mult, stalled, ops, syncs,
         # stalls, errors_injected, enospc_errors, corrupt_reads, sync_s}
         "disks?": dict,
+        # shared file-level page pool (storage/pagecache.py PageCachePool):
+        # budget/occupancy/eviction gauges for the one per-process pool
+        "page_cache?": {
+            "page_size": int,
+            "capacity_bytes": int,
+            "bytes": int,
+            "pages": int,
+            "evictions": int,
+            "invalidations": int,
+            "readahead_batches": int,
+        },
         "regions?": {
             "usable_regions": int,
             "satellite": str,
@@ -420,7 +445,14 @@ STATUS_SCHEMA: dict = {
     ],
     "storage": [
         {"tag": str, "version": int, "durable_version": int, "keys": int,
-         "queue_bytes": int, "read_latency": _LATENCY_SPEC}
+         "queue_bytes": int, "read_latency": _LATENCY_SPEC,
+         # durable engines: file-level page-cache counters for this
+         # store's files + the ssd engine's parsed-page cache gauges
+         "page_cache?": {
+             "hits": int, "misses": int,
+             "readahead_pages": int, "readahead_hits": int,
+             "parsed_hits": int, "parsed_misses": int, "parsed_bytes": int,
+         }}
     ],
     "latency_bands": {
         "commit": _LATENCY_SPEC,
@@ -570,6 +602,12 @@ ROLE_METRICS_SCHEMA: dict = {
         "ReadsPerSec": _NUM,
         "MutationsPerSec": _NUM,
         "ReadP99Ms": _NUM,
+        # durable engines: cumulative page-cache counters (storage/
+        # pagecache.py) — present when the store exposes the block
+        "PageCacheHits?": int,
+        "PageCacheMisses?": int,
+        "PageCacheReadaheadHits?": int,
+        "PageCacheParsedHits?": int,
     },
     "SequencerMetrics": {
         "Elapsed": _NUM,

@@ -949,7 +949,7 @@ class StorageServer:
 
         def fields() -> dict:
             r = self.counters.rates(self.loop.now())
-            return {
+            out = {
                 "Tag": self.tag,
                 "Version": self.version.get(),
                 "DurableVersion": self.durable_version,
@@ -960,6 +960,16 @@ class StorageServer:
                 "MutationsPerSec": r.get("mutations_applied", 0.0),
                 "ReadP99Ms": self.read_latency.snapshot()["p99"] * 1e3,
             }
+            pcs = getattr(self.store, "page_cache_stats", None)
+            if pcs is not None:
+                # durable engines: cumulative page-cache counters
+                # (storage/pagecache.py) in the periodic event stream
+                s = pcs()
+                out["PageCacheHits"] = s["hits"]
+                out["PageCacheMisses"] = s["misses"]
+                out["PageCacheReadaheadHits"] = s["readahead_hits"]
+                out["PageCacheParsedHits"] = s["parsed_hits"]
+            return out
 
         self._metrics_emitter = spawn_role_metrics(
             self.loop, self.process, trace, "StorageMetrics", fields,

@@ -188,8 +188,11 @@ class Worker:
             dq = None
             if self.fs is not None and p.get("path"):
                 from ..storage.diskqueue import DiskQueue
+                from ..storage.pagecache import maybe_cached
 
-                dq = DiskQueue(self.fs.open(p["path"], proc))
+                # the TLog's queue file rides the shared page cache too
+                # (spilled-entry re-reads are its hot read path)
+                dq = DiskQueue(maybe_cached(self.fs, self.fs.open(p["path"], proc)))
             t = TLog(proc, loop, start_version=p["start_version"],
                      initial_tags=p["seeds"], known_committed=p["known_committed"],
                      disk_queue=dq, spill_bytes=self.knobs.TLOG_SPILL_BYTES,

@@ -175,6 +175,26 @@ class CoreKnobs(Knobs):
         # the owning process through the ordinary kill/recovery machinery
         # rather than wedging the commit plane (storage/files.py)
         self.init("IO_TIMEOUT_S", 5.0)
+        # file-level page cache (storage/pagecache.py, the AsyncFileCached
+        # analog / reference PAGE_CACHE_4K pool): ONE byte-bounded LRU
+        # pool per process lifetime shared by every storage file (B-tree
+        # data+header, memory-engine WAL, TLog queue); 0 disables.
+        # PAGE_CACHE_4K is the cache page size; READAHEAD_PAGES is how
+        # many extra pages a sequential-scan miss fetches in the same
+        # pread.  Simulation sometimes shrinks the pool to a few pages so
+        # chaos seeds stress eviction/refill instead of an always-hot
+        # cache.
+        self.init(
+            "PAGE_CACHE_BYTES",
+            2 << 20 if r is None or not r.coinflip(0.25) else 1 << 14,
+        )
+        self.init("PAGE_CACHE_4K", 4096)
+        self.init("READAHEAD_PAGES", 8)
+        # the ssd engine's PARSED-page cache budget (storage/btree.py):
+        # decoded pages held above the file-level cache, in approximate
+        # heap bytes — byte-bounded so a few huge leaves can't blow the
+        # host heap (was a page COUNT blind to page size)
+        self.init("BTREE_CACHE_BYTES", 4 << 20)
 
         # device supervisor (conflict/supervisor.py): the DEFAULT_BACKOFF
         # family applied to the hardware conflict backend.  Every device

@@ -36,11 +36,23 @@ from collections import OrderedDict
 from ..runtime.serialize import BinaryReader, BinaryWriter
 from .diskqueue import DiskQueue
 from .files import SimFilesystem
+from .pagecache import maybe_cached
 
 _LEAF, _BRANCH = 0, 1
 _FANOUT = 128  # entries per page: fanout**2 = 16K leaves ≈ 2M keys at 1 branch level
 
 _TOP = b"\xff" * 64  # sorts above any real key in this codebase
+
+# first-read chunk for _read_page: one bounded pread covers the 8-byte
+# header AND the whole body for any page up to this size (the common
+# case); only an oversized page pays a second read for its tail
+_READ_CHUNK = 4096
+
+# parsed-page cache accounting overhead: per-page / per-entry constants
+# approximating the Python object cost around the raw key/value bytes, so
+# the byte budget tracks the real heap, not just payload
+_PAGE_OVERHEAD = 96
+_ENTRY_OVERHEAD = 48
 
 
 class BTreeKeyValueStore:
@@ -53,15 +65,24 @@ class BTreeKeyValueStore:
         fs: SimFilesystem,
         path: str,
         process,
-        cache_pages: int = 512,
+        cache_bytes: int = 4 << 20,
     ) -> None:
         self._fs = fs
         self._path = path
         self._process = process
-        self._cache_pages = cache_pages
-        self._files = [fs.open(path + ".a", process), fs.open(path + ".b", process)]
-        self._hdr = DiskQueue(fs.open(path + ".hdr", process))
-        self._cache: OrderedDict[tuple[int, int], list] = OrderedDict()
+        # parsed-page cache budget in BYTES (was a page COUNT, blind to
+        # page size — a few huge leaves could blow the host heap)
+        self._cache_budget = cache_bytes
+        self._cache_bytes = 0
+        # data + header files ride the shared file-level page cache when
+        # the filesystem has one armed (storage/pagecache.py)
+        self._files = [
+            maybe_cached(fs, fs.open(path + ".a", process)),
+            maybe_cached(fs, fs.open(path + ".b", process)),
+        ]
+        self._hdr = DiskQueue(maybe_cached(fs, fs.open(path + ".hdr", process)))
+        # (file_id, offset) -> (parsed page, approx bytes)
+        self._cache: OrderedDict[tuple[int, int], tuple[list, int]] = OrderedDict()
         # leaf directory: parallel sorted lists (first_key, offset, count)
         self._dir_keys: list[bytes] = []
         self._dir_offs: list[int] = []
@@ -255,6 +276,20 @@ class BTreeKeyValueStore:
             target -= n
         return None
 
+    def page_cache_stats(self) -> dict:
+        """The KernelStats-style page-cache counter block the status doc's
+        per-role `storage[*].page_cache` renders: file-level hit/miss/
+        read-ahead counters summed over this store's cached files, plus
+        the parsed-page cache's own hit/miss and live byte gauge."""
+        from .pagecache import file_stats_block
+
+        return file_stats_block(
+            (*self._files, self._hdr.file),
+            parsed_hits=self.cache_hits,
+            parsed_misses=self.cache_misses,
+            parsed_bytes=self._cache_bytes,
+        )
+
     def disk_usage(self) -> tuple[int, int | None]:
         """(bytes used, capacity|None) — the fullest of this store's disks
         (data files + header), the free-space input ratekeeper reads.  The
@@ -327,8 +362,8 @@ class BTreeKeyValueStore:
     # ---- recovery -----------------------------------------------------------
     @classmethod
     def recover(cls, fs: SimFilesystem, path: str, process,
-                cache_pages: int = 512) -> "BTreeKeyValueStore":
-        store = cls(fs, path, process, cache_pages)
+                cache_bytes: int = 4 << 20) -> "BTreeKeyValueStore":
+        store = cls(fs, path, process, cache_bytes)
         records = store._hdr.recover()
         if not records:
             return store
@@ -392,17 +427,24 @@ class BTreeKeyValueStore:
         if hit is not None:
             self.cache_hits += 1
             self._cache.move_to_end(key)
-            return hit
+            return hit[0]
         self.cache_misses += 1
         f = self._files[self._file_id]
         # checksum mismatches are retried once: the sim's corrupt-on-read
         # fault (disk.corrupt_read) is a transient media error; only a
         # second failure means the page is really gone
         for attempt in (0, 1):
-            head = f.pread(off, 8)
-            r = BinaryReader(head)
+            # ONE bounded read covers header + body for any page up to
+            # _READ_CHUNK (the common case — was two preads: 8-byte
+            # header, then body); only an oversized page reads its tail
+            chunk = f.pread(off, _READ_CHUNK)
+            r = BinaryReader(chunk[:8])
             ln, crc = r.u32(), r.u32()
-            body = f.pread(off + 8, ln)
+            if 8 + ln <= len(chunk):
+                body = chunk[8: 8 + ln]
+            else:
+                body = chunk[8:] + f.pread(off + len(chunk),
+                                           8 + ln - len(chunk))
             if len(body) == ln and (zlib.crc32(body) & 0xFFFFFFFF) == crc:
                 break
             if attempt == 1:
@@ -427,18 +469,70 @@ class BTreeKeyValueStore:
         assert kind == _LEAF
         return keys, vals
 
+    @staticmethod
+    def _page_bytes(page) -> int:
+        """Approximate heap bytes of one parsed page (payload + per-entry
+        object overhead) — the unit the byte-bounded cache budget evicts
+        by, so one huge leaf costs what it weighs."""
+        kind, keys, vals = page
+        n = _PAGE_OVERHEAD + len(keys) * _ENTRY_OVERHEAD
+        for k in keys:
+            n += len(k)
+        if kind == _LEAF:
+            for v in vals:
+                n += len(v)
+        else:
+            n += len(vals) * 24
+        return n
+
     def _cache_put(self, key, page) -> None:
-        self._cache[key] = page
-        self._cache.move_to_end(key)
-        while len(self._cache) > self._cache_pages:
-            self._cache.popitem(last=False)
+        old = self._cache.pop(key, None)
+        if old is not None:
+            self._cache_bytes -= old[1]
+        nbytes = self._page_bytes(page)
+        self._cache[key] = (page, nbytes)
+        self._cache_bytes += nbytes
+        # byte-bounded LRU: evict oldest until under budget; the newest
+        # entry always survives (a single over-budget page still caches —
+        # evicting it would thrash every touch)
+        while self._cache_bytes > self._cache_budget and len(self._cache) > 1:
+            _k, (_pg, nb) = self._cache.popitem(last=False)
+            self._cache_bytes -= nb
 
     # ---- memtable fold (COW leaf rewrite) -----------------------------------
     def _fold_memtable(self) -> None:
+        """Fold the memtable into COW-rewritten leaves.  ATOMIC against
+        the disk fault plane: an append refused mid-fold (ENOSPC /
+        injected IOError — DiskSwizzle's bread and butter) restores the
+        memtable AND the leaf directory to their pre-fold state before
+        re-raising, so the durability loop's retry re-folds everything.
+        Without the rollback a refused append lost the already-consumed
+        memtable and left the directory half-rewritten — acked-data loss
+        the memory engine's WAL-push-first discipline rules out but this
+        engine didn't (found by the PageCacheChaos spec, pinned by
+        tests/test_pagecache.py).  Orphaned pages appended before the
+        failure are harmless: append-only file, nothing references them."""
+        saved = (
+            self._dir_keys[:], self._dir_offs[:], self._dir_cnts[:],
+            self._dir_bytes[:], self._live_bytes,
+        )
         items = sorted(self._mem.items())
         clears = sorted(self._clears)
         self._mem = {}
         self._clears = []
+        try:
+            self._fold_memtable_inner(items, clears)
+        except IOError:
+            (self._dir_keys, self._dir_offs, self._dir_cnts,
+             self._dir_bytes, self._live_bytes) = saved
+            self._mem = dict(items)
+            self._clears = list(clears)
+            from ..runtime.coverage import testcov
+
+            testcov("btree.fold_rolled_back")
+            raise
+
+    def _fold_memtable_inner(self, items, clears) -> None:
         if not self._dir_keys:
             rows = [(k, v) for k, v in items if v is not None]
             self._replace_leaves(0, 0, rows)
@@ -518,19 +612,45 @@ class BTreeKeyValueStore:
     async def _compact(self) -> None:
         """Bulk-write the live tree into the other data file, then swap the
         header.  Crash-safe: the old file is untouched until the header
-        names the new one; a crash mid-compaction recovers the old root."""
+        names the new one; a crash mid-compaction recovers the old root.
+        Fault-atomic like the fold: an append refused mid-rewrite (disk
+        fault plane) restores the in-memory directory, un-journals the
+        truncate, and re-raises — the durability retry compacts again.
+        A failure at/after the sync keeps the NEW in-memory tree: its
+        pages are all buffered in the new file, so the retried sync +
+        header swap lands them (the durable root stays old throughout)."""
         rows = list(self._tree_range(b"", _TOP))
         other = 1 - self._file_id
         f = self._files[other]
+        saved = (
+            self._dir_keys[:], self._dir_offs[:], self._dir_cnts[:],
+            self._dir_bytes[:], self._live_bytes, self._file_id,
+            self._appended,
+        )
         f.truncate()
         self._file_id = other
         self._appended = 0
         self._cache.clear()
+        self._cache_bytes = 0
         self._dir_keys, self._dir_offs, self._dir_cnts = [], [], []
         self._dir_bytes = []
-        self._replace_leaves(0, 0, rows)
-        self._live_bytes = max(sum(len(k) + len(v) for k, v in rows), 1)
-        root = self._write_branches()
+        try:
+            self._replace_leaves(0, 0, rows)
+            self._live_bytes = max(sum(len(k) + len(v) for k, v in rows), 1)
+            root = self._write_branches()
+        except IOError:
+            (self._dir_keys, self._dir_offs, self._dir_cnts,
+             self._dir_bytes, self._live_bytes, self._file_id,
+             self._appended) = saved
+            f.cancel_truncate()
+            # parsed pages cached during the aborted rewrite are keyed by
+            # offsets the restored file no longer matches — drop them all
+            self._cache.clear()
+            self._cache_bytes = 0
+            from ..runtime.coverage import testcov
+
+            testcov("btree.compact_rolled_back")
+            raise
         await f.sync()
         self._write_header(root)
         await self._hdr.sync()

@@ -53,8 +53,8 @@ class DiskState:
     __slots__ = (
         "capacity", "latency_mult", "stall_until", "error_budget",
         "buggify_fault_after",
-        "ops", "syncs", "stalls", "errors_injected", "enospc_errors",
-        "corrupt_reads", "sync_s",
+        "ops", "reads", "syncs", "stalls", "errors_injected",
+        "enospc_errors", "corrupt_reads", "sync_s",
     )
 
     def __init__(self) -> None:
@@ -69,6 +69,7 @@ class DiskState:
         # per disk per cooldown keeps every class firing without storms
         self.buggify_fault_after = 0.0
         self.ops = 0
+        self.reads = 0                    # preads only (ops counts all)
         self.syncs = 0
         self.stalls = 0
         self.errors_injected = 0
@@ -239,6 +240,7 @@ class SimFile:
         assert not self._closed
         self._st.unsynced.clear()
         self._st.pending_truncate = True
+        self._invalidate_cache()
 
     def cancel_truncate(self) -> None:
         """Un-journal a truncate that no sync has applied yet: the synced
@@ -248,9 +250,18 @@ class SimFile:
         destroy the old contents at the next sync)."""
         assert not self._closed
         self._st.pending_truncate = False
+        self._invalidate_cache()
+
+    def _invalidate_cache(self) -> None:
+        """Page-cache coherence hook (storage/pagecache.py): file contents
+        below the append tail changed (truncate / cancel_truncate / kill-
+        time unsynced drop) — any cached pages of this path are stale."""
+        pool = self._fs.page_pool
+        if pool is not None:
+            pool.invalidate_file(self.path)
 
     # -- read path ----------------------------------------------------------
-    def pread(self, offset: int, length: int) -> bytes:
+    def pread(self, offset: int, length: int, faults: bool = True) -> bytes:
         """Positional read of the current contents (same-process view) —
         the IAsyncFile::read analog the paged B-tree engine and the TLog
         spill path use.  O(length + unsynced chunks), never a full copy.
@@ -259,10 +270,14 @@ class SimFile:
         is flipped (a transient media error): every paged consumer sits
         behind a checksum (DiskQueue frames, B-tree pages), so the flip
         surfaces as a detected-and-retried corruption, never silent bad
-        data."""
+        data.  `faults=False` skips the flip — the page cache's fill path
+        (storage/pagecache.py), which re-applies the SAME flip on the
+        assembled result so corruption is never cached and a retry
+        heals."""
         st = self._st
         disk = self._fs.disk(self.path)
         disk.ops += 1
+        disk.reads += 1
         parts: list[bytes] = []
         pos, need = offset, length
         base = 0 if st.pending_truncate else len(st.synced)
@@ -284,8 +299,14 @@ class SimFile:
                 need -= take
             chunk_start = chunk_end
         out = b"".join(parts)
+        return self._maybe_corrupt(out) if faults else out
+
+    def _maybe_corrupt(self, out: bytes) -> bytes:
+        """The `disk.corrupt_read` transient flip, factored out so the
+        page cache applies it ABOVE its pages (one flip per logical pread,
+        same as the bare file — never cached)."""
         if out and self._process is not None and buggify("disk.corrupt_read"):
-            disk.corrupt_reads += 1
+            self._fs.disk(self.path).corrupt_reads += 1
             i = self._fs.rng.random_int(0, len(out))
             out = out[:i] + bytes([out[i] ^ 0xFF]) + out[i + 1:]
         return out
@@ -312,6 +333,10 @@ class SimFile:
     def _drop_unsynced(self) -> None:
         self._st.unsynced.clear()
         self._st.pending_truncate = False
+        # the power-kill coherence rule: the file's contents just REGRESSED
+        # to the synced prefix, so cached pages (which reflected the
+        # buffered view) die with the process
+        self._invalidate_cache()
 
     def close(self) -> None:
         self._closed = True
@@ -338,6 +363,12 @@ class SimFilesystem:
         # None = off, the unit-test-friendly default.
         self.io_timeout_s: float | None = None
         self.trace = None  # TraceCollector for IoTimeoutKilled events
+        # shared file-level page cache (storage/pagecache.py PageCachePool),
+        # armed by the cluster assembly from the PAGE_CACHE_* knobs.  None =
+        # no cache, bit-identical raw-file behavior.  Lives on the
+        # filesystem object only as the wiring point — cached pages belong
+        # to a PROCESS lifetime, so every boot installs a FRESH pool.
+        self.page_pool = None
 
     def reattach(self, loop: EventLoop, rng: DeterministicRandom) -> None:
         """Point at a new EventLoop/RNG (whole-cluster restart builds a new
@@ -348,6 +379,9 @@ class SimFilesystem:
         self.rng = rng.split()
         self._handles.clear()
         self.trace = None
+        # a reattach is a new process lifetime: cached pages die with the
+        # old one (the booting cluster installs its own fresh pool)
+        self.page_pool = None
         for d in self._disks.values():
             d.stall_until = 0.0
 
@@ -426,6 +460,7 @@ class SimFilesystem:
                 "latency_mult": d.latency_mult,
                 "stalled": self.loop.now() < d.stall_until,
                 "ops": d.ops,
+                "reads": d.reads,
                 "syncs": d.syncs,
                 "stalls": d.stalls,
                 "errors_injected": d.errors_injected,
@@ -493,6 +528,8 @@ class SimFilesystem:
 
     def delete(self, path: str) -> None:
         self._files.pop(path, None)
+        if self.page_pool is not None:
+            self.page_pool.invalidate_file(path)
 
     def list(self, prefix: str = "") -> list[str]:
         return sorted(p for p in self._files if p.startswith(prefix))

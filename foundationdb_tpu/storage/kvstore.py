@@ -19,6 +19,7 @@ from ..roles.storage import MemoryKeyValueStore
 from ..runtime.serialize import BinaryReader, BinaryWriter
 from .diskqueue import DiskQueue
 from .files import SimFile, SimFilesystem
+from .pagecache import maybe_cached
 
 _SNAPSHOT, _SET, _CLEAR, _COMMIT = 0, 1, 2, 3
 
@@ -33,7 +34,10 @@ class DurableMemoryKeyValueStore(MemoryKeyValueStore):
     def __init__(self, fs: SimFilesystem, path: str, process) -> None:
         super().__init__()
         self.meta: dict[str, int] = {}
-        self._dq = DiskQueue(fs.open(path, process))
+        # the WAL rides the shared file-level page cache when armed (the
+        # reference puts AsyncFileCached under EVERY storage file); its
+        # read path is the recovery scan + spilled-entry re-reads
+        self._dq = DiskQueue(maybe_cached(fs, fs.open(path, process)))
         self._since_snapshot = 0
         self._snapshot_threshold = 1 << 20
 
@@ -80,6 +84,14 @@ class DurableMemoryKeyValueStore(MemoryKeyValueStore):
         input ratekeeper's storage_server_min_free_space analog reads."""
         f = self._dq.file
         return f._fs.usage_for(f.path)
+
+    def page_cache_stats(self) -> dict:
+        """Same counter-block shape as the ssd engine's (status schema's
+        `storage[*].page_cache`): this engine has no parsed-page cache, so
+        those rows stay zero."""
+        from .pagecache import file_stats_block
+
+        return file_stats_block((self._dq.file,))
 
     def _write_snapshot(self) -> None:
         w = BinaryWriter().u8(_SNAPSHOT)

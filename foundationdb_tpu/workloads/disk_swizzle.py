@@ -76,7 +76,13 @@ class DiskSwizzleWorkload(Workload):
             # campaign REQUIREMENT, not a dice roll — the live traffic
             # below consumes the forced queries in the disk I/O paths
             for site in ("disk.slow", "disk.stall", "disk.error",
-                         "disk.enospc", "disk.corrupt_read"):
+                         "disk.enospc", "disk.corrupt_read",
+                         # the page-cache memory-pressure flush
+                         # (storage/pagecache.py): queried on cache fills,
+                         # so it fires only when a durable engine's read
+                         # path is really caching — always safe (the pool
+                         # is clean by construction)
+                         "cache.evict_all"):
                 buggify.force(site, 1)
             capped: list[str] = []
             for i, path in enumerate(self._data_disks(fs)):

@@ -28,8 +28,12 @@ are constructor kwargs (camelCase -> snake_case), except `runSetup=false`
 which skips the workload's setup phase (the restarting-pair part-2
 convention: the data under test rode the reboot).  Everything before the
 first `testName` configures the cluster — including `backend=supervised`
-(the DeviceSupervisor-wrapped TPU/XLA conflict backend) and
-`sampleRate=R` (transaction-timeline sampling into the trace files).
+(the DeviceSupervisor-wrapped TPU/XLA conflict backend), `sampleRate=R`
+(transaction-timeline sampling into the trace files), and
+`knob.NAME=value` lines (the reference's per-test --knob_ overrides:
+applied via set_knob after knob construction, so they compose with chaos
+randomization and unknown names fail loudly — e.g. the PageCacheChaos
+spec shrinks PAGE_CACHE_BYTES / BTREE_CACHE_BYTES to stress the cache).
 `run_spec` builds the cluster, composes the workloads, runs them, and
 returns the metrics dict; its seed/trace_sink/sample_rate keywords are
 the per-seed artifact hooks the soak harness (tools/soak.py) drives, and
@@ -212,6 +216,11 @@ def parse_spec(text: str) -> tuple[str, dict, list[tuple[str, dict]]]:
                 )
             except ValueError as e:
                 raise ValueError(f"line {lineno}: {key}: {e}") from None
+        elif key.startswith("knob."):
+            # the reference's per-test knob override lines (--knob_ path):
+            # applied via set_knob after knob construction, so they compose
+            # with chaos randomization and unknown names fail loudly
+            cluster_kwargs.setdefault("knob_overrides", {})[key[5:]] = val
         else:
             raise ValueError(
                 f"line {lineno}: unknown cluster key {key!r} "

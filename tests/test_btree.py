@@ -25,7 +25,7 @@ def _crash(store):
 
 def test_model_fuzz_with_crashes():
     loop, fs = _fixture()
-    store = BTreeKeyValueStore(fs, "t", None, cache_pages=8)
+    store = BTreeKeyValueStore(fs, "t", None, cache_bytes=2048)
     rng = random.Random(11)
     model: dict[bytes, bytes] = {}
     committed: dict[bytes, bytes] = {}
@@ -63,11 +63,13 @@ def test_model_fuzz_with_crashes():
                 committed = dict(model)
             else:
                 _crash(store)
-                store = BTreeKeyValueStore.recover(fs, "t", None, cache_pages=8)
+                store = BTreeKeyValueStore.recover(fs, "t", None, cache_bytes=2048)
                 model = dict(committed)
                 assert store.meta.get("durable_version", 0) <= step
         assert store.range_read(b"", b"\xff" * 8, 1 << 30) == sorted(model.items())
-        assert len(store._cache) <= 8  # page cache stays bounded
+        # parsed-page cache stays BYTE-bounded (a lone over-budget page is
+        # the only allowed overhang — evicting it would thrash)
+        assert store._cache_bytes <= 2048 or len(store._cache) == 1
 
     loop.run_until(loop.spawn(run()), 1e12)
 

@@ -37,3 +37,17 @@ def test_camel_case_mapping():
     )
     assert ck == {"seed": 5, "chaos": True}
     assert st == [("Cycle", {"txns_per_client": 7})]
+
+
+def test_knob_override_lines():
+    """`knob.NAME=value` cluster lines land in knob_overrides (and an
+    unknown knob name fails loudly at cluster construction)."""
+    _t, ck, _st = parse_spec(
+        "seed=5\nknob.PAGE_CACHE_BYTES=8192\ntestName=Cycle\n"
+    )
+    assert ck["knob_overrides"] == {"PAGE_CACHE_BYTES": "8192"}
+    from foundationdb_tpu.control.recoverable import RecoverableCluster
+
+    with pytest.raises(KeyError):
+        RecoverableCluster(seed=1, durable=False,
+                           knob_overrides={"NO_SUCH_KNOB": "1"})
