@@ -61,7 +61,12 @@ class DataDistributor:
         self.heals = 0
         self.shard_splits = 0
         self.shard_merges = 0
+        self.hot_relocations = 0
         self.exclusion_drains = 0
+        # ops freeze switch (fdbcli `datadistribution off` analog): stops
+        # load-driven movement (splits/merges/hot relocations) — healing
+        # and exclusion drains keep running, they are correctness moves
+        self.frozen = False
         # boundaries THIS distributor created by splitting: the only merge
         # candidates — bootstrap shard boundaries are the cluster's
         # configured topology and are never collapsed (conservative vs the
@@ -80,6 +85,7 @@ class DataDistributor:
         self._tasks = [
             loop.spawn(self._heal_loop(), TaskPriority.COORDINATION, "dd-heal"),
             loop.spawn(self._split_loop(), TaskPriority.COORDINATION, "dd-split"),
+            loop.spawn(self._hot_shard_loop(), TaskPriority.COORDINATION, "dd-hot"),
             loop.spawn(self._exclusion_loop(), TaskPriority.COORDINATION, "dd-exclude"),
         ]
 
@@ -598,32 +604,76 @@ class DataDistributor:
         dt = now - prev_t
         return [max(t - pv, 0) / dt for t, pv in zip(totals, prev)]
 
+    def shard_load(self) -> list[dict]:
+        """Per-shard load from the storage servers' SAMPLED metric plane
+        (the DataDistributionTracker poll: one waitMetrics-style query per
+        shard, O(sampled keys), never a scan).  Each row: shard bounds,
+        serving team, sampled bytes, and read/write bytes-per-ksec."""
+        cc = self.cc
+        bounds = [b""] + list(cc.storage_splits) + [None]
+        out = []
+        for i, team in enumerate(cc.storage_teams_tags):
+            b, e = bounds[i], bounds[i + 1]
+            hi = e if e is not None else TOP_KEY
+            m = cc._tag_to_ss[team[0]].metrics_range(b, hi)
+            # reads load-balance ACROSS replicas, each charging only the
+            # server that served it: the team's read bandwidth is the SUM
+            # over replicas (polling one server can hide a shard's entire
+            # read load behind replica routing).  Writes apply on every
+            # replica — the same logical traffic — so those dedupe with
+            # max, which also rides over a just-healed replica's cold
+            # sample.  Bytes likewise: every replica holds the same data.
+            for t in team[1:]:
+                m2 = cc._tag_to_ss[t].metrics_range(b, hi)
+                m["bytes_read_per_ksec"] += m2["bytes_read_per_ksec"]
+                m["bytes_written_per_ksec"] = max(
+                    m["bytes_written_per_ksec"], m2["bytes_written_per_ksec"]
+                )
+                m["bytes"] = max(m["bytes"], m2["bytes"])
+                m["sampled_keys"] = max(m["sampled_keys"], m2["sampled_keys"])
+            m["begin"], m["end"] = b, e
+            m["team"] = list(team)
+            out.append(m)
+        return out
+
     async def _split_loop(self) -> None:
         cc = self.cc
         while True:
             await self.loop.delay(self.knobs.DD_SPLIT_INTERVAL, TaskPriority.COORDINATION)
             gen = cc.generation
-            if gen is None or cc._recovering or self._moving:
+            if gen is None or cc._recovering or self._moving or self.frozen:
                 continue
             teams = cc.storage_teams_tags
             if len(teams) < 2:
                 continue
             bounds = [b""] + list(cc.storage_splits) + [None]
-            # size metrics walk resident data: refresh them every few ticks,
-            # not every poll (the reference samples, it never rescans)
+            # byte sizes come from the byte SAMPLE every tick (O(sampled
+            # keys)); the key-count trigger still needs resident counts, so
+            # those refresh only every few ticks (the reference samples, it
+            # never rescans)
+            load = self.shard_load()
+            sizes = [m["bytes"] for m in load]
             self._metrics_tick += 1
-            if self._sizes is None or len(self._sizes) != len(teams) \
+            if self._counts is None or len(self._counts) != len(teams) \
                     or self._metrics_tick % 4 == 0:
-                sizes, counts = [], []
+                counts = []
                 for i, team in enumerate(teams):
                     b, e = bounds[i], bounds[i + 1]
                     ss = cc._tag_to_ss[team[0]]
-                    n, bts = ss.shard_metrics(b, e if e is not None else TOP_KEY)
+                    n, _bts = ss.shard_metrics(b, e if e is not None else TOP_KEY)
                     counts.append(n)
-                    sizes.append(bts)
-                self._sizes, self._counts = sizes, counts
-            sizes, counts = self._sizes, self._counts
-            wrates = self._write_rates(gen, len(teams))
+                self._counts = counts
+            self._sizes = sizes
+            counts = self._counts
+            # committed write bandwidth: the proxies' exact differenced
+            # counters OR the storage-side write sample, whichever sees
+            # more — the sample survives proxy restarts, the counters
+            # catch traffic too young for the decayed sample
+            prates = self._write_rates(gen, len(teams))
+            wrates = [
+                max(p, m["bytes_written_per_ksec"] / 1e3)
+                for p, m in zip(prates, load)
+            ]
 
             # split candidates in priority order: write-HOT, then byte size,
             # then key count (the halves of the reference's shardSplitter
@@ -644,7 +694,9 @@ class DataDistributor:
             for idx, why in candidates:
                 ss = cc._tag_to_ss[teams[idx][0]]
                 b, e = bounds[idx], bounds[idx + 1]
-                k = ss.split_point(b, e if e is not None else TOP_KEY)
+                # splitMetrics-style: the sampled byte-weighted median (a
+                # too-sparse sample falls back to the exact key median)
+                k = ss.sampled_split_point(b, e if e is not None else TOP_KEY)
                 if k is not None:
                     hot, key, reason = idx, k, why
                     break
@@ -697,6 +749,81 @@ class DataDistributor:
                     "DDShardSplit", SplitKey=repr(key), From=hot, To=cold,
                     HotKeys=sizes[hot],
                 )
+
+    # -- hot-shard relocation (read-hot analog) ------------------------------
+    async def _hot_shard_loop(self) -> None:
+        """Priority relocation queue for HOT shards (the reference's
+        readHotShard detection feeding the relocation queue at
+        PRIORITY_REBALANCE): a shard whose sampled read+write bandwidth
+        exceeds DD_HOT_SHARD_BYTES_PER_KSEC moves — whole, via the normal
+        two-phase MoveKeys — to the least-loaded team, hottest first, one
+        relocation per tick.  Relocation only fires when it strictly
+        improves the loaded team's total (anti-thrash), and the bandwidth
+        sample restarts cold on the destination, which is natural
+        hysteresis against ping-ponging the same shard."""
+        cc = self.cc
+        while True:
+            await self.loop.delay(
+                self.knobs.DD_HOT_RELOCATION_INTERVAL, TaskPriority.COORDINATION
+            )
+            if cc.generation is None or cc._recovering or self._moving \
+                    or self.frozen:
+                continue
+            teams = cc.storage_teams_tags
+            if len(teams) < 2:
+                continue
+            try:
+                load = self.shard_load()
+            except KeyError:
+                continue  # map churn mid-poll; next tick realigns
+            combined = [
+                m["bytes_read_per_ksec"] + m["bytes_written_per_ksec"]
+                for m in load
+            ]
+            hot_queue = sorted(
+                (
+                    i for i in range(len(load))
+                    if combined[i] > self.knobs.DD_HOT_SHARD_BYTES_PER_KSEC
+                ),
+                key=lambda i: -combined[i],
+            )
+            if not hot_queue:
+                continue
+            team_load: dict[frozenset, float] = {}
+            for i, m in enumerate(load):
+                ts = frozenset(m["team"])
+                team_load[ts] = team_load.get(ts, 0.0) + combined[i]
+            for i in hot_queue:
+                testcov("dd.hot_shard_detected")
+                cc.trace.trace(
+                    "DDHotShard", Begin=repr(load[i]["begin"]),
+                    End=repr(load[i]["end"]),
+                    BytesPerKSec=int(combined[i]), Team=load[i]["team"],
+                )
+                hot_ts = frozenset(load[i]["team"])
+                others = [ts for ts in team_load if ts != hot_ts]
+                if not others:
+                    break  # one distinct team: nowhere to relocate
+                cold_ts = min(others, key=lambda ts: team_load[ts])
+                if team_load[cold_ts] + combined[i] >= team_load[hot_ts]:
+                    continue  # would not improve the hot team's total
+                dest = next(
+                    list(m["team"]) for m in load
+                    if frozenset(m["team"]) == cold_ts
+                )
+                b, e = load[i]["begin"], load[i]["end"]
+                try:
+                    moved = await self.move_range(b, e, dest)
+                except IOError:
+                    break  # disk fault plane; next tick retries
+                if moved:
+                    self.hot_relocations += 1
+                    testcov("dd.hot_shard_relocate")
+                    cc.trace.trace(
+                        "DDHotShardMove", Begin=repr(b), End=repr(e),
+                        BytesPerKSec=int(combined[i]), Dest=dest,
+                    )
+                break  # one relocation per tick, hottest first
 
     async def _merge_shards(self, i: int) -> bool:
         """Collapse adjacent shards i and i+1 into one (the reference's

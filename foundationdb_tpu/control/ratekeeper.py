@@ -47,6 +47,12 @@ class Ratekeeper:
         self.manual_tps_cap: float | None = None
         self.limit_reason = "unlimited"
         self.limiting_server: str | None = None
+        # WHICH range drove a storage-side limit (the load-metric plane's
+        # attribution): the limiting server's hottest sampled key + its
+        # bytes/sec, so saturation reports point at the hot range, not
+        # just the hot process; None while unlimited / TLog-limited
+        self.limiting_shard: str | None = None
+        self.limiting_shard_bps: float = 0.0
         # e-brake: a queue crossed its HARD limit or a disk is nearly full —
         # the budget is slammed to the floor (no smoothing) until it clears
         self.e_brake = False
@@ -192,6 +198,20 @@ class Ratekeeper:
             self.batch_tps_budget = max(
                 0.0, (self.tps_budget - 0.25 * self.max_tps) / 0.75
             )
+        # attribute the hot RANGE behind a storage-side limit from the
+        # limiting server's bandwidth samples (busiest sampled key): the
+        # difference between "ss-1-r0 is slow" and "rw/000123 is hot"
+        shard, shard_bps = None, 0.0
+        if reason in ("storage_queue", "storage_lag", "e_brake"):
+            ss = next((s for s in self.storage if s.tag == limiting), None)
+            busiest = getattr(ss, "busiest_range", None)
+            if busiest is not None:
+                hot_key, shard_bps = busiest()
+                if hot_key is not None:
+                    shard = repr(hot_key)
+        self.limiting_shard = shard
+        self.limiting_shard_bps = shard_bps
+
         if reason != self.limit_reason:
             if reason == "storage_queue":
                 testcov("ratekeeper.limit_storage_queue")
@@ -209,6 +229,8 @@ class Ratekeeper:
                     track_latest="ratekeeper",
                     Reason=reason,
                     LimitingServer=limiting,
+                    LimitingShard=shard,
+                    LimitingShardBps=round(shard_bps, 1),
                     TPSBudget=round(self.tps_budget, 1),
                 )
         self.limit_reason = reason
@@ -224,6 +246,8 @@ class Ratekeeper:
             "batch_tps_budget": self.batch_tps_budget,
             "limit_reason": self.limit_reason,
             "limiting_server": self.limiting_server,
+            "limiting_shard": self.limiting_shard,
+            "limiting_shard_bps": self.limiting_shard_bps,
             "e_brake": self.e_brake,
             "storage_lag_smoothed": {
                 tag: s.smooth_total() for tag, s in self._lag_smoothers.items()

@@ -1172,10 +1172,39 @@ class RecoverableCluster:
                 for tag, ss in sorted(cc._tag_to_ss.items())
             ]
 
+        def _metrics_rows():
+            # the load-metric plane as a readable range (\xff\xff/metrics/):
+            # one row per shard at its begin key, value = the sampled
+            # waitMetrics estimate (bytes + bandwidth + serving team) —
+            # clients read shard load like any other key range
+            import json
+
+            dd = getattr(self, "dd", None)
+            if dd is None:
+                return []
+            try:
+                load = dd.shard_load()
+            except KeyError:
+                return []  # keyServers map churning mid-read
+            return [
+                (b"\xff\xff/metrics/" + m["begin"],
+                 json.dumps({
+                     "end": repr(m["end"]) if m["end"] is not None else None,
+                     "bytes": m["bytes"],
+                     "bytes_read_per_ksec":
+                         round(m["bytes_read_per_ksec"], 1),
+                     "bytes_written_per_ksec":
+                         round(m["bytes_written_per_ksec"], 1),
+                     "team": list(m["team"]),
+                 }).encode())
+                for m in load
+            ]
+
         view.special_ranges = [
             (b"\xff\xff/keyservers/", _keyservers_rows),
             (b"\xff\xff/excluded/", _excluded_rows),
             (b"\xff\xff/server_list/", _serverlist_rows),
+            (b"\xff\xff/metrics/", _metrics_rows),
         ]
         db = Database(self.loop, view, self.rng,
                       client_knobs=self.client_knobs)

@@ -82,10 +82,56 @@ def _messages(trace, ratekeeper) -> list[dict[str, Any]]:
                     f" on {ratekeeper.limiting_server}"
                     if ratekeeper.limiting_server else ""
                 )
+                + (
+                    # the load-metric plane's attribution: the hot RANGE
+                    # behind the limit, not just the hot process
+                    f" (hot range {ratekeeper.limiting_shard})"
+                    if ratekeeper.limiting_shard else ""
+                )
                 + f" (tps_budget {ratekeeper.tps_budget:.0f})"
             ),
         })
     return msgs
+
+
+def _data_block(cluster, dd) -> dict[str, Any]:
+    """cluster.data — the movingData/totalKVBytes analog, fed entirely by
+    the storage servers' SAMPLED metric plane (dd.shard_load: one
+    waitMetrics-style poll per shard, no scans): total estimated bytes,
+    bytes overlapping in-flight fetchKeys ranges, and the top-k hottest
+    shards by sampled read+write bandwidth."""
+    load = dd.shard_load()
+    moving_ranges = [
+        (fs.begin, fs.end_key)
+        for ss in cluster.storage for fs in ss._fetching
+    ]
+
+    def overlaps(m) -> bool:
+        me = m["end"] if m["end"] is not None else b"\xff\xff\xff\xff\xff\xff"
+        return any(b < me and m["begin"] < e for b, e in moving_ranges)
+
+    ranked = sorted(
+        load,
+        key=lambda m: -(m["bytes_read_per_ksec"] + m["bytes_written_per_ksec"]),
+    )
+    return {
+        "total_kv_bytes_estimate": sum(m["bytes"] for m in load),
+        "moving_bytes_estimate": sum(m["bytes"] for m in load if overlaps(m)),
+        "moving_ranges": len(moving_ranges),
+        "shard_count": len(load),
+        "hot_shards": [
+            {
+                "begin": repr(m["begin"]),
+                "end": repr(m["end"]) if m["end"] is not None else None,
+                "bytes": m["bytes"],
+                "bytes_read_per_ksec": round(m["bytes_read_per_ksec"], 1),
+                "bytes_written_per_ksec":
+                    round(m["bytes_written_per_ksec"], 1),
+                "team": list(m["team"]),
+            }
+            for m in ranked[:3]
+        ],
+    }
 
 
 def _kernel_rollup(resolvers) -> dict[str, Any]:
@@ -299,9 +345,15 @@ def cluster_status(cluster) -> dict[str, Any]:
             "heals": dd.heals,
             "shard_splits": dd.shard_splits,
             "shard_merges": dd.shard_merges,
+            "hot_relocations": dd.hot_relocations,
+            "frozen": dd.frozen,
             "shards": len(controller.storage_teams_tags),
             "exclusion_drains": dd.exclusion_drains,
         }
+        try:
+            doc["cluster"]["data"] = _data_block(cluster, dd)
+        except KeyError:
+            pass  # keyServers map churning mid-status; omit this scrape
     if controller is not None:
         doc["cluster"]["backup_running"] = controller.backup_worker is not None
         # round-5 operational surface (ManagementAPI state + liveness map)
@@ -388,7 +440,26 @@ STATUS_SCHEMA: dict = {
         ],
         "data_distribution?": {
             "moves": int, "heals": int, "shard_splits": int,
-            "shard_merges": int, "shards": int, "exclusion_drains": int,
+            "shard_merges": int, "hot_relocations": int, "frozen": bool,
+            "shards": int, "exclusion_drains": int,
+        },
+        # the load-metric plane roll-up (cluster.data — movingData /
+        # totalKVBytes analog): sampled byte totals + top-k hot shards
+        "data?": {
+            "total_kv_bytes_estimate": int,
+            "moving_bytes_estimate": int,
+            "moving_ranges": int,
+            "shard_count": int,
+            "hot_shards": [
+                {
+                    "begin": str,
+                    "end": (str, type(None)),
+                    "bytes": int,
+                    "bytes_read_per_ksec": (int, float),
+                    "bytes_written_per_ksec": (int, float),
+                    "team": list,
+                }
+            ],
         },
         "backup_running?": bool,
         "configuration?": {
@@ -531,6 +602,9 @@ STATUS_SCHEMA: dict = {
         "batch_tps_budget": (int, float),
         "limit_reason": str,
         "limiting_server": (str, type(None)),
+        # hot-range attribution from the bandwidth samples (repr'd key)
+        "limiting_shard": (str, type(None)),
+        "limiting_shard_bps": (int, float),
         "e_brake": bool,
         "storage_lag_smoothed": dict,
         # keyed by tag (storage) / `tlogN` slot name (tlogs) — the
@@ -602,6 +676,12 @@ ROLE_METRICS_SCHEMA: dict = {
         "ReadsPerSec": _NUM,
         "MutationsPerSec": _NUM,
         "ReadP99Ms": _NUM,
+        # load-metric plane gauges (roles/storage_metrics.py): byte-sample
+        # totals + decayed read/write bandwidth estimates
+        "SampledBytes": int,
+        "SampledKeys": int,
+        "BytesReadPerKSec": _NUM,
+        "BytesWrittenPerKSec": _NUM,
         # durable engines: cumulative page-cache counters (storage/
         # pagecache.py) — present when the store exposes the block
         "PageCacheHits?": int,

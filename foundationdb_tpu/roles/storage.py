@@ -19,6 +19,7 @@ import bisect
 from typing import Iterable
 
 from .sequencer import NotifiedVersion
+from .storage_metrics import StorageServerMetrics
 from .types import (
     FutureVersion,
     GetKeyReply,
@@ -330,6 +331,10 @@ class StorageServer:
         # gets and range reads share one tracker — the storage half of the
         # reference's readLatencyBands
         self.read_latency = LatencyTracker()
+        # the load-metric plane (StorageMetrics.actor.h analog): byte
+        # sample on the write path, bandwidth samples on the serve path —
+        # what DD split decisions and ratekeeper attribution poll
+        self.load_metrics = StorageServerMetrics(knobs)
         self.counters = CounterCollection("StorageServer")
         self.c_reads = self.counters.counter("reads")
         self.c_selector_reads = self.counters.counter("selector_reads")
@@ -414,9 +419,18 @@ class StorageServer:
                     continue
                 live = self._route_fetching(version, muts) if self._fetching else muts
                 nb = 0
+                now = self.loop.now()
                 for m in live:
                     self.overlay.apply(version, m, self.store.get)
                     nb += len(m.key) + len(m.value or b"")
+                    if m.type == MutationType.CLEAR_RANGE:
+                        self.load_metrics.on_clear_range(m.key, m.value, now)
+                    else:
+                        # atomics charge the operand length: the folded
+                        # value is close enough for a sampled estimate
+                        self.load_metrics.on_set(
+                            m.key, len(m.value or b""), now
+                        )
                 if nb:
                     self._qbytes.append((version, nb))
                     self.queue_bytes += nb
@@ -540,9 +554,18 @@ class StorageServer:
         for k, val in rows:
             self.overlay.apply(snap_v, Mutation(MutationType.SET_VALUE, k, val),
                                self.store.get)
+        # the moved-in range enters the byte sample too: the snapshot rows
+        # are presence (not traffic), buffer replays are recent writes
+        now = self.loop.now()
+        self.load_metrics.byte_sample.clear_range(fs.begin, fs.end_key)
+        self.load_metrics.on_fetch_rows(rows)
         for version, m in fs.buffer:
             if version > snap_v:
                 self.overlay.apply(version, m, self.store.get)
+                if m.type == MutationType.CLEAR_RANGE:
+                    self.load_metrics.on_clear_range(m.key, m.value, now)
+                else:
+                    self.load_metrics.on_set(m.key, len(m.value or b""), now)
         self._fetching.remove(fs)
         self._range_floor.merge(fs.begin, fs.end_key, snap_v, max)
         # watches parked while the range was in flight (plus any registered
@@ -597,6 +620,26 @@ class StorageServer:
                 )
         return max(n, 0), max(bts, 0)
 
+    def metrics_range(self, begin: bytes, end: bytes) -> dict:
+        """The waitMetrics query surface (StorageMetrics.actor.h): sampled
+        bytes + bytes_read_per_ksec / bytes_written_per_ksec estimates for
+        [begin, end), O(sampled keys), never a data scan — what
+        DataDistribution polls every tracker tick."""
+        return self.load_metrics.metrics(begin, end, self.loop.now())
+
+    def sampled_split_point(self, begin: bytes, end: bytes) -> bytes | None:
+        """splitMetrics analog: the sampled byte-weighted median of
+        [begin, end).  A range too sparse to sample (simulation-scale
+        shards) falls back to the exact key median — a split decision must
+        not fail just because every entry is below the sampling unit."""
+        k = self.load_metrics.split_point(begin, end)
+        return k if k is not None else self.split_point(begin, end)
+
+    def busiest_range(self) -> tuple[bytes | None, float]:
+        """(hot key, combined read+write bytes/sec) from the bandwidth
+        samples — ratekeeper's limiting-shard attribution input."""
+        return self.load_metrics.busiest_range(self.loop.now())
+
     def split_point(self, begin: bytes, end: bytes) -> bytes | None:
         """Median live key of [begin, end) — data distribution's split-key
         sample.  The committed median (O(log n) via the store) serves; only
@@ -618,6 +661,7 @@ class StorageServer:
         end_k = TOP_KEY if end is None else end
         self.store.clear_range(begin, end_k)
         self.overlay.purge_range(begin, end_k)
+        self.load_metrics.drop_range(begin, end_k)
         self._range_floor.assign(begin, end_k, 0)  # no longer served here
 
     def _floor_violation(self, begin: bytes, end: bytes, version: Version) -> bool:
@@ -704,8 +748,12 @@ class StorageServer:
         except (TransactionTooOld, FutureVersion) as e:
             req.reply_error(e)
             return
-        req.reply(GetValueReply(self.overlay.get(r.key, r.version, self.store.get)))
+        val = self.overlay.get(r.key, r.version, self.store.get)
+        req.reply(GetValueReply(val))
         self.c_reads.add(1)
+        self.load_metrics.on_read(
+            r.key, len(r.key) + len(val or b""), self.loop.now()
+        )
         self.read_latency.observe(self.loop.now() - t0)
         g_trace_batch.add("StorageServer.getValue.Replied", r.debug_id)
 
@@ -780,6 +828,9 @@ class StorageServer:
         more = len(out) > r.limit
         req.reply(GetKeyValuesReply(out[: r.limit], more))
         self.c_reads.add(1)
+        now = self.loop.now()
+        for k, v in out[: r.limit]:
+            self.load_metrics.on_read(k, len(k) + len(v), now)
         self.read_latency.observe(self.loop.now() - t0)
 
     # -- key selectors (storageserver.actor.cpp findKey / getKeyQ) -----------
@@ -897,6 +948,7 @@ class StorageServer:
             self.find_key(r.sel, r.version, r.range_begin, r.range_end)
         ))
         self.c_reads.add(1)
+        self.load_metrics.on_read(r.sel.key, len(r.sel.key), self.loop.now())
         self.c_selector_reads.add(1)
         self.read_latency.observe(self.loop.now() - t0)
         g_trace_batch.add("StorageServer.getKey.Replied", r.debug_id)
@@ -948,7 +1000,9 @@ class StorageServer:
             self._metrics_emitter.cancel()
 
         def fields() -> dict:
-            r = self.counters.rates(self.loop.now())
+            now = self.loop.now()
+            r = self.counters.rates(now)
+            lm = self.load_metrics
             out = {
                 "Tag": self.tag,
                 "Version": self.version.get(),
@@ -959,6 +1013,13 @@ class StorageServer:
                 "ReadsPerSec": r.get("reads", 0.0),
                 "MutationsPerSec": r.get("mutations_applied", 0.0),
                 "ReadP99Ms": self.read_latency.snapshot()["p99"] * 1e3,
+                # load-metric plane gauges (byte/bandwidth samples)
+                "SampledBytes": lm.byte_sample.total,
+                "SampledKeys": len(lm.byte_sample),
+                "BytesReadPerKSec":
+                    lm.read_bw.rate_range(b"", TOP_KEY, now) * 1e3,
+                "BytesWrittenPerKSec":
+                    lm.write_bw.rate_range(b"", TOP_KEY, now) * 1e3,
             }
             pcs = getattr(self.store, "page_cache_stats", None)
             if pcs is not None:

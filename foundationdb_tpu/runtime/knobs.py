@@ -268,6 +268,30 @@ class CoreKnobs(Knobs):
         # a fraction of the split point so merge/split cannot oscillate
         self.init("DD_SHARD_MERGE_BYTES", 1_000_000)
         self.init("DD_SHARD_MERGE_KEYS", 10_000)
+        # -- load-metric plane (roles/storage_metrics.py; StorageMetrics.
+        # actor.h byteSample / bytesReadSample analogs).  The sampling UNIT
+        # is the Horvitz-Thompson weight floor: an entry of size sz is
+        # sampled with probability min(1, sz/unit), so per-range estimates
+        # are unbiased with relative error ~ sqrt(unit / range_bytes).
+        # Simulation sometimes shrinks the units so chaos seeds exercise
+        # the dense-sample paths too.
+        self.init(
+            "BYTE_SAMPLE_UNIT",
+            512 if r is None or not r.coinflip(0.25) else 32,
+        )
+        self.init(
+            "BANDWIDTH_SAMPLE_UNIT",
+            512 if r is None or not r.coinflip(0.25) else 32,
+        )
+        # bandwidth decay time constant (reference's 2x SMOOTHING_AMOUNT
+        # spirit): rate = decayed_weight / tau
+        self.init("BANDWIDTH_SMOOTH_SECONDS", 10.0)
+        # hot-shard detection + priority relocation (readHotShard analog):
+        # a shard whose combined read+write sampled bandwidth exceeds the
+        # threshold — and that cannot usefully split — is queued for
+        # relocation to the least-loaded team every relocation interval
+        self.init("DD_HOT_SHARD_BYTES_PER_KSEC", 50_000_000)
+        self.init("DD_HOT_RELOCATION_INTERVAL", 2.0)
 
     @property
     def mvcc_window_versions(self) -> int:

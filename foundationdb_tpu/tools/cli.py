@@ -44,6 +44,9 @@ commands:
   coordinators <n>            change the coordinator quorum size
   maintenance <zone> <secs>   suppress healing for a zone while it bounces
   throttle <tps>|off          cap cluster admission at tps transactions/s
+  datadistribution on|off     resume/freeze load-driven shard movement
+                              (splits, merges, hot-shard relocations;
+                              healing and exclusion drains keep running)
   move <begin> <end> <shard>  MoveKeys: migrate a range to shard's team
   backup start <prefix>       continuous backup + snapshot into the cluster fs
   backup status | stop        backup progress / stop
@@ -241,6 +244,15 @@ class Cli:
             tps = None if args[0] == "off" else float(args[0])
             self._run(mgmt.set_throttle(self.db, tps))
             return "throttle cleared" if tps is None else f"throttled to {tps} tps"
+        if cmd == "datadistribution":
+            # fdbcli `datadistribution on|off`: freeze/resume LOAD-driven
+            # movement only — correctness moves (healing, exclusion
+            # drains) are never frozen
+            if args and args[0] in ("on", "off"):
+                c.dd.frozen = args[0] == "off"
+            return ("data distribution frozen (splits/merges/hot "
+                    "relocations paused)" if c.dd.frozen
+                    else "data distribution running")
         if cmd == "move":
             # move BEGIN END SHARD_IDX — MoveKeys through data distribution
             dest = c.controller.storage_teams_tags[int(args[2])]
@@ -392,6 +404,12 @@ def main() -> None:
         # flowlint itself defaults to the full tree when no paths are
         # given, so flag-only invocations (`cli lint --json`) work too
         sys.exit(lint_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "top":
+        # live cluster monitor against a running tools/server.py gateway
+        # (tools/fdbtop.py; `cli top --port P`, `--once` for one frame)
+        from .fdbtop import main as top_main
+
+        sys.exit(top_main(sys.argv[2:]))
     Cli().repl()
 
 

@@ -26,10 +26,23 @@ tightened (TARGET_STORAGE_QUEUE_BYTES et al) so the knee lands at a
 simulable rate — the SHAPE of the curve and the limiting reason are the
 claim, not the absolute tps.
 
+`--mode hot` is the load-metric plane's proof harness instead: the same
+open-loop ramp, but key choice is zipf-skewed WITHOUT rank scattering so
+the hot keys pile into ONE shard, and the two curves are data
+distribution ON vs FROZEN (dd.frozen — the `datadistribution off`
+analog) on the same seed.  With DD frozen the hot team's storage queue
+is the knee; with DD on, sampled-bandwidth splits and hot-shard
+relocations spread the hot range across teams and the knee moves right.
+The artifact (BENCH_SAT_r02.json) records both curves plus the per-step
+DD counters (splits, hot relocations, shard count) and ratekeeper's
+hot-range attribution.
+
 Usage:
     python -m foundationdb_tpu.tools.saturate --out BENCH_SAT_r01.json \
         [--steps 25,50,100,200,400] [--step-duration 4] [--keys 4000] \
         [--seed 11]
+    python -m foundationdb_tpu.tools.saturate --mode hot \
+        --out BENCH_SAT_r02.json
 """
 
 from __future__ import annotations
@@ -45,6 +58,18 @@ _KNOBS_COMMON = {
     "TARGET_STORAGE_QUEUE_BYTES": 1 << 15,
     "STORAGE_HARD_LIMIT_BYTES": 1 << 17,
     "BTREE_CACHE_BYTES": 1 << 15,
+}
+
+# hot-shard mode overrides (on top of _KNOBS_COMMON): thresholds scaled
+# down so sampled-bandwidth splits and hot-shard detection fire at
+# Python-simulable rates, merges disabled so the harness never un-splits
+# what it is trying to measure
+_KNOBS_HOT = {
+    **_KNOBS_COMMON,
+    "DD_SHARD_SPLIT_BYTES": 1 << 19,
+    "DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC": 1 << 14,
+    "DD_SHARD_MERGE_BYTES": 0,
+    "DD_HOT_SHARD_BYTES_PER_KSEC": 4_000_000,
 }
 
 _VALUE_BYTES = 128
@@ -141,10 +166,11 @@ def _band_delta(now: dict, before: dict) -> dict:
 
 
 def _run_step(cluster, offered_tps: float, duration: float, keys: int,
-              rng) -> dict:
+              rng, pick=None) -> dict:
     """One open-loop load step: start a transaction every 1/offered_tps
     sim seconds (regardless of completions, outstanding capped), measure
-    what actually commits and at what latency."""
+    what actually commits and at what latency.  `pick(crng) -> key index`
+    overrides the uniform key choice (the hot-shard mode's zipf)."""
     from ..client.transaction import RETRYABLE_ERRORS
     from ..control.status import cluster_status
     from ..runtime.core import ActorCancelled
@@ -164,6 +190,9 @@ def _run_step(cluster, offered_tps: float, duration: float, keys: int,
     }
     pc0 = _page_cache_totals(cluster)
 
+    def choose(crng) -> int:
+        return pick(crng) if pick is not None else crng.random_int(0, keys)
+
     async def one_txn(crng):
         outstanding[0] += 1
         try:
@@ -174,8 +203,8 @@ def _run_step(cluster, offered_tps: float, duration: float, keys: int,
                     await tr.get_read_version()
                     grv_lat.append(loop.now() - t0)
                     for _ in range(3):
-                        await tr.get(_key(crng.random_int(0, keys)))
-                    tr.set(_key(crng.random_int(0, keys)),
+                        await tr.get(_key(choose(crng)))
+                    tr.set(_key(choose(crng)),
                            b"y" * _VALUE_BYTES)
                     t0 = loop.now()
                     await tr.commit()
@@ -236,8 +265,11 @@ def _run_step(cluster, offered_tps: float, duration: float, keys: int,
             "tps_budget": round(rk.get("tps_budget", 0.0), 1),
             "limit_reason": rk.get("limit_reason", "?"),
             "limiting_server": rk.get("limiting_server"),
+            # the load-metric plane's attribution: WHICH range was hot
+            "limiting_shard": rk.get("limiting_shard"),
             "e_brake": rk.get("e_brake", False),
         },
+        "data_distribution": doc["cluster"].get("data_distribution"),
         "page_cache_delta": {k: pc1[k] - pc0[k] for k in pc1},
     }
 
@@ -296,6 +328,89 @@ def run_curve(cache_on: bool, steps: list[float], step_duration: float,
     }
 
 
+def _zipf_pick(keys: int, skew: float):
+    """Unscattered zipf picker: hot ranks stay CONTIGUOUS at the bottom
+    of the keyspace, so the skewed load lands in one shard — the input
+    the load-metric plane exists to detect."""
+    import bisect
+
+    w = [(i + 1) ** -skew for i in range(keys)]
+    total = sum(w)
+    cdf, acc = [], 0.0
+    for x in w:
+        acc += x / total
+        cdf.append(acc)
+
+    def pick(crng) -> int:
+        return min(bisect.bisect_left(cdf, crng.random()), keys - 1)
+
+    return pick
+
+
+def run_hot_curve(dd_on: bool, steps: list[float], step_duration: float,
+                  keys: int, seed: int, skew: float) -> dict:
+    """One hot-shard curve: preload a uniform keyspace, then ramp
+    zipf-hot (unscattered) load with data distribution either live or
+    FROZEN — the same seed both ways, so the only difference is whether
+    the sampled metric plane gets to move data."""
+    from ..control.recoverable import RecoverableCluster
+    from ..control.status import cluster_status
+    from ..runtime.core import DeterministicRandom
+
+    c = RecoverableCluster(
+        seed=seed, n_storage_shards=2, storage_replication=2,
+        storage_engine="ssd", knob_overrides=dict(_KNOBS_HOT),
+    )
+    c.dd.frozen = not dd_on
+    _preload(c, keys)
+
+    rng = DeterministicRandom(seed + 7)
+    pick = _zipf_pick(keys, skew)
+    curve: list[dict] = []
+    knee = None
+    for tps in steps:
+        row = _run_step(c, tps, step_duration, keys, rng, pick=pick)
+        curve.append(row)
+        dd = row.get("data_distribution") or {}
+        print(
+            f"[saturate] dd={'on' if dd_on else 'frozen'} "
+            f"offered={tps} achieved={row['achieved_tps']} "
+            f"reason={row['ratekeeper']['limit_reason']} "
+            f"shard={row['ratekeeper']['limiting_shard']} "
+            f"splits={dd.get('shard_splits')} "
+            f"hot_moves={dd.get('hot_relocations')}",
+            file=sys.stderr,
+        )
+        if knee is None and (
+            row["ratekeeper"]["limit_reason"] != "unlimited"
+            or row["achieved_tps"] < 0.8 * tps
+        ):
+            knee = row
+    doc = cluster_status(c)
+    data = doc["cluster"].get("data", {})
+    ddb = doc["cluster"].get("data_distribution", {})
+    c.stop()
+    return {
+        "dd": "on" if dd_on else "frozen",
+        "skew": skew,
+        "steps": curve,
+        "final": {
+            "shard_count": data.get("shard_count"),
+            "shard_splits": ddb.get("shard_splits"),
+            "shard_merges": ddb.get("shard_merges"),
+            "hot_relocations": ddb.get("hot_relocations"),
+            "hot_shards": data.get("hot_shards"),
+        },
+        "knee": {
+            "offered_tps": knee["offered_tps"],
+            "achieved_tps": knee["achieved_tps"],
+            "limit_reason": knee["ratekeeper"]["limit_reason"],
+            "limiting_server": knee["ratekeeper"]["limiting_server"],
+            "limiting_shard": knee["ratekeeper"]["limiting_shard"],
+        } if knee is not None else None,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", default="25,50,100,200,400",
@@ -306,25 +421,37 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=11)
     ap.add_argument("--out", default="BENCH_SAT_r01.json")
     ap.add_argument("--cache", choices=("both", "on", "off"), default="both")
+    ap.add_argument("--mode", choices=("cache", "hot"), default="cache",
+                    help="cache: page-cache on/off curves (r01); hot: "
+                         "zipf-hot ramp with DD on vs frozen (r02)")
+    ap.add_argument("--skew", type=float, default=1.2,
+                    help="zipf exponent for --mode hot key choice")
     args = ap.parse_args(argv)
 
     steps = [float(s) for s in args.steps.split(",") if s]
     curves = []
-    if args.cache in ("both", "on"):
-        curves.append(run_curve(True, steps, args.step_duration,
-                                args.keys, args.seed))
-    if args.cache in ("both", "off"):
-        curves.append(run_curve(False, steps, args.step_duration,
-                                args.keys, args.seed))
+    if args.mode == "hot":
+        for dd_on in (False, True):
+            curves.append(run_hot_curve(dd_on, steps, args.step_duration,
+                                        args.keys, args.seed, args.skew))
+    else:
+        if args.cache in ("both", "on"):
+            curves.append(run_curve(True, steps, args.step_duration,
+                                    args.keys, args.seed))
+        if args.cache in ("both", "off"):
+            curves.append(run_curve(False, steps, args.step_duration,
+                                    args.keys, args.seed))
 
     doc = {
-        "metric": "saturation_curve",
+        "metric": ("hot_shard_saturation" if args.mode == "hot"
+                   else "saturation_curve"),
         "engine": "ssd",
         "keys": args.keys,
         "value_bytes": _VALUE_BYTES,
         "seed": args.seed,
         "step_duration_s": args.step_duration,
-        "knob_overrides": _KNOBS_COMMON,
+        "knob_overrides": (_KNOBS_HOT if args.mode == "hot"
+                           else _KNOBS_COMMON),
         "curves": curves,
     }
     with open(args.out, "w") as f:

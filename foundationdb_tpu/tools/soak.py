@@ -271,9 +271,53 @@ def triage_seed(events: list[dict[str, Any]], spec_path: str,
             1 for e in events if e.get("Type") == "SlowTask"
         ),
         "blob_retry_count": blob_retry_count(events),
+        "hottest_shards": hottest_shards(events),
         "slowest_transaction": slow[0] if slow else None,
         "repro": repro_command(spec_path, seed),
     }
+
+
+def hottest_shards(events: list[dict[str, Any]], k: int = 3) -> list[dict]:
+    """Per-seed hottest-shard table out of the trace stream (the
+    load-metric plane's triage view): `DDHotShard` events carry the
+    sampled per-range bandwidth at each detection, aggregated here per
+    range (detections + peak).  When none fired, fall back to the busiest
+    storage INSTANCES by their `StorageMetrics` bandwidth gauges, so a
+    loaded seed always gets a table — just range-attributed only when
+    detection actually crossed the knob."""
+    by_range: dict = {}
+    for e in events:
+        if e.get("Type") != "DDHotShard":
+            continue
+        key = (e.get("Begin"), e.get("End"))
+        row = by_range.setdefault(key, {
+            "begin": e.get("Begin"), "end": e.get("End"),
+            "detections": 0, "peak_bytes_per_ksec": 0.0,
+            "team": e.get("Team"),
+        })
+        row["detections"] += 1
+        row["peak_bytes_per_ksec"] = max(
+            row["peak_bytes_per_ksec"], float(e.get("BytesPerKSec") or 0.0)
+        )
+    ranked = sorted(
+        by_range.values(), key=lambda r: -r["peak_bytes_per_ksec"]
+    )[:k]
+    if ranked:
+        return ranked
+    by_inst: dict = {}
+    for e in events:
+        if e.get("Type") != "StorageMetrics":
+            continue
+        inst = e.get("Instance") or e.get("Tag")
+        bw = (float(e.get("BytesReadPerKSec") or 0.0)
+              + float(e.get("BytesWrittenPerKSec") or 0.0))
+        row = by_inst.setdefault(
+            inst, {"instance": inst, "peak_bytes_per_ksec": 0.0}
+        )
+        row["peak_bytes_per_ksec"] = max(row["peak_bytes_per_ksec"], bw)
+    return sorted(
+        by_inst.values(), key=lambda r: -r["peak_bytes_per_ksec"]
+    )[:k]
 
 
 def blob_retry_count(events: list[dict[str, Any]]) -> int:
@@ -590,6 +634,23 @@ def render_markdown(report: dict) -> str:
                 f"SlowTask: {t.get('slow_task_count', 0)}, "
                 f"blob retries: {t.get('blob_retry_count', 0)}",
             ]
+            hot = t.get("hottest_shards", [])
+            if hot:
+                lines.append("- hottest shards (load-metric plane):")
+                for h in hot:
+                    if "instance" in h:
+                        lines.append(
+                            f"  - busiest storage `{h['instance']}`: peak "
+                            f"{h['peak_bytes_per_ksec']:.0f} B/ksec "
+                            "(StorageMetrics)"
+                        )
+                    else:
+                        lines.append(
+                            f"  - `{h['begin']}`..`{h['end']}`: peak "
+                            f"{h['peak_bytes_per_ksec']:.0f} B/ksec, "
+                            f"{h['detections']} detection(s), "
+                            f"team {','.join(h.get('team') or [])}"
+                        )
             for ev in t.get("first_events", []):
                 lines.append(
                     f"  - `{ev['Type']}` sev {ev['Severity']} "

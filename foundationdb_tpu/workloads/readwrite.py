@@ -65,6 +65,7 @@ class ReadWriteWorkload(Workload):
         writes_per_tx: int = 1,
         value_bytes: int = 16,
         skew: float = 0.0,
+        scatter: bool = True,
         range_reads_per_tx: int = 0,
         range_len: int = 10,
         warmup: float = 0.0,
@@ -77,6 +78,10 @@ class ReadWriteWorkload(Workload):
         self.writes_per_tx = writes_per_tx
         self.value_bytes = value_bytes
         self.skew = skew
+        # scatter=False keeps hot zipf ranks CONTIGUOUS at the bottom of
+        # the keyspace — the hot-shard workload: skewed traffic piles into
+        # one shard so the load-metric plane has something to detect
+        self.scatter = scatter
         self.range_reads_per_tx = range_reads_per_tx
         self.range_len = range_len
         self.warmup = warmup
@@ -106,8 +111,9 @@ class ReadWriteWorkload(Workload):
     def _pick(self, crng) -> int:
         if self.skew <= 0.0:
             return crng.random_int(0, self.keys)
-        rank = bisect.bisect_left(self._zipf_cdf, crng.random())
-        return (min(rank, self.keys - 1) * _SCATTER) % self.keys
+        rank = min(bisect.bisect_left(self._zipf_cdf, crng.random()),
+                   self.keys - 1)
+        return (rank * _SCATTER) % self.keys if self.scatter else rank
 
     async def setup(self, cluster, rng) -> None:
         if self.skew > 0.0:

@@ -72,6 +72,7 @@ from .low_space import LowSpaceWorkload
 from .fuzzapi import FuzzApiWorkload
 from .increment import IncrementWorkload
 from .kill_region import KillRegionWorkload
+from .random_move import RandomMoveKeysWorkload
 from .readwrite import ReadWriteWorkload
 from .rollback import RollbackWorkload
 from .save_and_kill import RestartKill, SaveAndKillWorkload, invariant_states
@@ -92,6 +93,7 @@ WORKLOAD_FACTORY = {
     "FuzzApi": FuzzApiWorkload,
     "ConfigureDatabase": ConfigureDatabaseWorkload,
     "ReadWrite": ReadWriteWorkload,
+    "RandomMoveKeys": RandomMoveKeysWorkload,
     "Swizzle": SwizzleWorkload,
     "WriteDuringRead": WriteDuringReadWorkload,
     "DeviceFault": DeviceFaultWorkload,

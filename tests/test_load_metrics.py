@@ -1,0 +1,403 @@
+"""The load-metric plane: byte-sampled StorageMetrics accuracy vs exact
+accounting, sampled split-point estimation, hot-shard relocation, the
+status/metrics schema surface, and the fdbtop renderer
+(fdbserver/StorageMetrics.actor.h byteSample/bytesReadSample;
+DataDistributionTracker's waitMetrics poll; the community fdbtop)."""
+
+import json
+import math
+import random
+
+from foundationdb_tpu.control.recoverable import RecoverableCluster
+from foundationdb_tpu.control.status import (
+    cluster_status,
+    validate_metrics_event,
+    validate_status,
+)
+from foundationdb_tpu.roles.storage_metrics import BandwidthSample, ByteSample
+from foundationdb_tpu.tools.fdbtop import render
+
+
+# ---------------------------------------------------------------------------
+# sampling accuracy vs exact accounting
+
+
+def test_byte_sample_unbiased_under_random_sizes():
+    """Horvitz–Thompson bound: for unit u and a range holding B exact
+    bytes, the estimate's standard deviation is at most sqrt(u * B) —
+    randomized key/value sizes must land within a few sigma, and the
+    whole-range totals must track across several units."""
+    rng = random.Random(20160)
+    entries = {}
+    for i in range(4000):
+        key = b"acc/%06d" % i
+        entries[key] = rng.randint(8, 600)  # spans below AND above unit
+    for unit in (64, 256, 1024):
+        s = ByteSample(unit)
+        for k, sz in entries.items():
+            s.set(k, sz)
+        exact_total = sum(entries.values())
+        sd = math.sqrt(unit * exact_total)
+        assert abs(s.total - exact_total) < 6 * sd + unit
+        # sub-range estimates: error bound scales with the RANGE's bytes
+        for lo, hi in ((0, 1000), (1000, 3000), (2500, 4000)):
+            b, e = b"acc/%06d" % lo, b"acc/%06d" % hi
+            exact = sum(
+                sz for k, sz in entries.items() if b <= k < e
+            )
+            est = s.bytes_range(b, e)
+            assert abs(est - exact) < 6 * math.sqrt(unit * exact) + unit
+
+
+def test_byte_sample_exact_above_unit():
+    """Entries at least as large as the unit are sampled with p=1 and
+    weight sz: the estimate is EXACT, not merely unbiased."""
+    s = ByteSample(128)
+    total = 0
+    for i in range(300):
+        sz = 128 + (i % 400)
+        s.set(b"big/%04d" % i, sz)
+        total += sz
+    assert s.total == total
+    assert s.bytes_range(b"big/", b"big0") == total
+
+
+def test_byte_sample_clear_and_reset_deterministic():
+    """The sample decision hashes the KEY: re-set/remove/clear always
+    touch the same entry, so mirrored mutations return the sample to
+    exactly its prior state (seeded sims replay identically)."""
+    s = ByteSample(256)
+    rng = random.Random(7)
+    sizes = {b"d/%05d" % i: rng.randint(10, 500) for i in range(2000)}
+    for k, sz in sizes.items():
+        s.set(k, sz)
+    before_total, before_len = s.total, len(s)
+    # re-set every key to the same size: nothing changes
+    for k, sz in sizes.items():
+        s.set(k, sz)
+    assert (s.total, len(s)) == (before_total, before_len)
+    # remove half, re-add: back to the same state
+    removed = list(sizes)[::2]
+    for k in removed:
+        s.remove(k)
+    for k in removed:
+        s.set(k, sizes[k])
+    assert (s.total, len(s)) == (before_total, before_len)
+    s.clear_range(b"d/", b"d0")
+    assert s.total == 0 and len(s) == 0
+
+
+def test_bandwidth_sample_tracks_rate_and_decays():
+    """Steady traffic at rate R holds the decayed estimate near R; going
+    idle for several time constants forgets it."""
+    tau = 10.0
+    s = BandwidthSample(64, tau)
+    rng = random.Random(99)
+    t = 0.0
+    # 300 B per 0.1s across a few keys = 3000 B/s, for 5*tau seconds
+    for _ in range(int(5 * tau / 0.1)):
+        t += 0.1
+        for _ in range(3):
+            s.add(b"bw/%02d" % rng.randint(0, 20), 100, t)
+    est = s.rate_range(b"bw/", b"bw0", t)
+    assert 0.7 * 3000 < est < 1.3 * 3000
+    # the busiest key is one of the sampled hot keys, at a plausible rate
+    k, r = s.busiest_key(t)
+    assert k is not None and k.startswith(b"bw/") and r > 0
+    # idle: five time constants later the estimate is noise
+    assert s.rate_range(b"bw/", b"bw0", t + 5 * tau) < 0.01 * 3000
+
+
+# ---------------------------------------------------------------------------
+# split-point estimation
+
+
+def test_split_point_near_byte_weighted_median():
+    s = ByteSample(128)
+    for i in range(3000):
+        s.set(b"sp/%05d" % i, 100)  # uniform weights
+    k = s.split_point(b"sp/", b"sp0")
+    assert k is not None
+    idx = int(k[3:])
+    # sampled median of a uniform keyspace lands near the middle
+    assert 1000 < idx < 2000
+
+
+def test_split_point_follows_byte_weight_not_key_count():
+    """One huge prefix dominates the bytes: the byte-weighted median must
+    sit inside it even though most KEYS are elsewhere."""
+    s = ByteSample(128)
+    for i in range(100):
+        s.set(b"a/%04d" % i, 5000)  # 500KB in 100 keys
+    for i in range(2000):
+        s.set(b"z/%04d" % i, 20)  # 40KB in 2000 keys
+    k = s.split_point(b"a/", b"z0")
+    assert k is not None and k < b"z/"  # median is in the heavy prefix
+    assert k > b"a/"  # but never AT the range start
+
+
+def test_storage_sampled_split_point_matches_exact_median():
+    """Against a live storage server: the sampled split point of a real
+    shard lands near the exact key median."""
+    c = RecoverableCluster(seed=881, n_storage_shards=2,
+                           storage_replication=2, durable=False)
+    db = c.database()
+
+    async def fill():
+        for base in range(0, 600, 50):
+            tr = db.create_transaction()
+            for i in range(base, base + 50):
+                tr.set(b"m/%05d" % i, b"v" * 40)
+            await tr.commit()
+
+    c.run_until(c.loop.spawn(fill()), 300)
+    ss = c.controller._tag_to_ss[c.controller.storage_teams_tags[0][0]]
+    k = ss.sampled_split_point(b"m/", b"m0")
+    assert k is not None
+    idx = int(k[2:])
+    assert 150 < idx < 450  # near the 300 median, sampling tolerance
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# hot-shard relocation (deterministic: manufactured team imbalance)
+
+
+def test_hot_shard_relocates_to_least_loaded_team():
+    """Two trafficked shards stacked on one team, an idle team elsewhere:
+    the hot loop must detect the hottest shard and move it — whole, via
+    the two-phase MoveKeys — to the idle team.  (With the hot shard ALONE
+    on its team the anti-thrash guard correctly refuses: moving the whole
+    load merely shifts the problem.)"""
+    c = RecoverableCluster(
+        seed=883, n_storage_shards=3, storage_replication=2, durable=False,
+        knob_overrides={
+            # splits/merges out of the way: relocation is the subject
+            "DD_SHARD_SPLIT_BYTES": 1 << 30,
+            "DD_SHARD_SPLIT_KEYS": 1 << 30,
+            "DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC": 1 << 30,
+            "DD_SHARD_MERGE_BYTES": 0,
+            "DD_HOT_SHARD_BYTES_PER_KSEC": 100_000,  # 100 B/s combined
+            "DD_HOT_RELOCATION_INTERVAL": 0.5,
+        },
+    )
+    db = c.database()
+    splits = list(c.controller.storage_splits)  # 3 shards -> 2 boundaries
+    team0 = list(c.controller.storage_teams_tags[0])
+    # shard-0 keys sort below the first boundary; shard-1 keys inside it
+    k_hot = b"A/%04d"
+    k_warm = splits[0] + b"/%04d"
+
+    async def fill():
+        tr = db.create_transaction()
+        for i in range(50):
+            tr.set(k_hot % i, b"v" * 64)
+            tr.set(k_warm % i, b"v" * 64)
+        await tr.commit()
+
+    c.run_until(c.loop.spawn(fill()), 300)
+
+    # manufacture the imbalance: pile shard 1 onto shard 0's team
+    moved = c.run_until(
+        c.loop.spawn(c.dd.move_range(splits[0], splits[1], team0)), 300
+    )
+    assert moved
+
+    async def drive_and_wait():
+        import random as _r
+
+        from foundationdb_tpu.client.transaction import RETRYABLE_ERRORS
+
+        prng = _r.Random(1)
+        deadline = c.loop.now() + 40.0
+        while c.loop.now() < deadline:
+            tr = db.create_transaction()
+            try:
+                for _ in range(6):
+                    await tr.get(k_hot % prng.randint(0, 49))
+                # enough warm traffic that the piled team's total STRICTLY
+                # exceeds the hot shard alone — the anti-thrash guard needs
+                # a real improvement, not an equality
+                for _ in range(3):
+                    await tr.get(k_warm % prng.randint(0, 49))
+                tr.set(k_hot % prng.randint(0, 49), b"w" * 64)
+                tr.set(k_warm % prng.randint(0, 49), b"w" * 64)
+                await tr.commit()
+            except RETRYABLE_ERRORS as e:
+                # e.g. TransactionTooOld: read version below the floor of a
+                # range the relocation just moved — retry like a real client
+                await tr.on_error(e)
+                continue
+            if c.dd.hot_relocations >= 1:
+                return True
+        return False
+
+    assert c.run_until(c.loop.spawn(drive_and_wait()), 600)
+    # the hot shard left the overloaded team
+    hot_team = set(c.controller.storage_teams_tags[0])
+    assert hot_team != set(team0)
+    c.stop()
+
+
+def test_datadistribution_freeze_stops_relocation():
+    """fdbcli `datadistribution off` analog: with dd.frozen the hot loop
+    must not move anything even under detectable load."""
+    c = RecoverableCluster(
+        seed=884, n_storage_shards=2, storage_replication=2, durable=False,
+        knob_overrides={
+            "DD_SHARD_SPLIT_BYTES": 1 << 30,
+            "DD_SHARD_SPLIT_KEYS": 1 << 30,
+            "DD_SHARD_SPLIT_WRITE_BYTES_PER_SEC": 1 << 30,
+            "DD_HOT_SHARD_BYTES_PER_KSEC": 100_000,
+            "DD_HOT_RELOCATION_INTERVAL": 0.5,
+        },
+    )
+    c.dd.frozen = True
+    db = c.database()
+
+    async def drive():
+        for _ in range(60):
+            tr = db.create_transaction()
+            tr.set(b"fz", b"x" * 200)
+            await tr.get(b"fz")
+            await tr.commit()
+        await c.loop.delay(3.0)
+
+    c.run_until(c.loop.spawn(drive()), 300)
+    assert c.dd.hot_relocations == 0 and c.dd.shard_splits == 0
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# schema surface: status cluster.data, StorageMetrics gauges, special keys
+
+
+def test_status_data_block_and_metrics_range():
+    c = RecoverableCluster(seed=882, n_storage_shards=2,
+                           storage_replication=2, durable=False)
+    db = c.database()
+
+    async def main():
+        for base in range(0, 200, 50):
+            tr = db.create_transaction()
+            for i in range(base, base + 50):
+                tr.set(b"sd/%05d" % i, b"v" * 30)
+            await tr.commit()
+        # read traffic so the read-bandwidth gauges move
+        tr = db.create_transaction()
+        for i in range(0, 200, 5):
+            await tr.get(b"sd/%05d" % i)
+        await tr.commit()
+        # one \xff\xff/metrics/ range read through the normal read path
+        tr = db.create_transaction()
+        rows = await tr.get_range(b"\xff\xff/metrics/", b"\xff\xff/metrics0",
+                                  limit=1000)
+        return rows
+
+    rows = c.run_until(c.loop.spawn(main()), 300)
+    doc = cluster_status(c)
+    validate_status(doc)  # schema covers cluster.data + ratekeeper fields
+    data = doc["cluster"]["data"]
+    assert data["shard_count"] == 2
+    assert data["total_kv_bytes_estimate"] > 0
+    assert data["hot_shards"] and "bytes_read_per_ksec" in data["hot_shards"][0]
+    assert "limiting_shard" in doc["ratekeeper"]
+
+    # special range: one row per shard, JSON values carrying the gauges
+    assert len(rows) == 2
+    for k, v in rows:
+        assert k.startswith(b"\xff\xff/metrics/")
+        m = json.loads(v)
+        for field in ("bytes", "bytes_read_per_ksec",
+                      "bytes_written_per_ksec", "team"):
+            assert field in m
+    c.stop()
+
+
+def test_storage_metrics_trace_event_gauges():
+    """The per-role StorageMetrics trace event carries the sampled gauges
+    and passes the metrics-event schema guard."""
+    c = RecoverableCluster(seed=885, n_storage_shards=2,
+                           storage_replication=2, durable=False)
+    db = c.database()
+
+    async def main():
+        for i in range(80):
+            tr = db.create_transaction()
+            tr.set(b"tm/%04d" % i, b"v" * 50)
+            await tr.get(b"tm/%04d" % i)
+            await tr.commit()
+        await c.loop.delay(c.knobs.METRICS_INTERVAL + 1.0)
+
+    c.run_until(c.loop.spawn(main()), 300)
+    evs = [e for e in c.trace.events if e["Type"] == "StorageMetrics"]
+    assert evs
+    for ev in evs:
+        validate_metrics_event(ev)
+    # the tm/ keys all land in shard 0: ITS servers' gauges must be live
+    # (the other shard's servers legitimately report zero)
+    assert max(ev["SampledBytes"] for ev in evs) > 0
+    assert max(ev["SampledKeys"] for ev in evs) > 0
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# fdbtop renderer (pure text unit; `cli top --once` is the live flavor)
+
+
+def test_fdbtop_render_frame():
+    doc = {
+        "cluster": {
+            "generation": {"epoch": 3, "state": "accepting", "count": 1},
+            "clock": 12.5,
+            "data": {
+                "total_kv_bytes_estimate": 1 << 20,
+                "moving_bytes_estimate": 2048,
+                "moving_ranges": 1,
+                "shard_count": 3,
+                "hot_shards": [],
+            },
+            "data_distribution": {"hot_relocations": 2, "frozen": True},
+            "messages": [{
+                "severity": 30, "name": "e_brake",
+                "description": "queue hard limit",
+            }],
+        },
+        "ratekeeper": {
+            "tps_budget": 500.0, "limit_reason": "storage_queue",
+            "limiting_server": "ss-0-r1", "limiting_shard": "b'hot/key'",
+            "limiting_shard_bps": 4096.0, "e_brake": True,
+        },
+        "proxy": {"committed_version": 900, "txns_committed": 100,
+                  "txns_conflicted": 5},
+        "tlogs": [{"version": 900, "bytes_queued": 4096, "locked": False}],
+        "storage": [{"tag": "ss-0-r0", "version": 900,
+                     "durable_version": 880, "queue_bytes": 1024,
+                     "keys": 1000}],
+    }
+    shards = [
+        {"begin": "b''", "bytes": 9000, "bytes_read_per_ksec": 2e6,
+         "bytes_written_per_ksec": 1e6, "team": ["ss-0-r0", "ss-0-r1"]},
+        {"begin": "b'\\x80'", "bytes": 100, "bytes_read_per_ksec": 0.0,
+         "bytes_written_per_ksec": 0.0, "team": ["ss-1-r0"]},
+    ]
+    prev = {"proxy": {"txns_committed": 80, "txns_conflicted": 5}}
+    frame = render(doc, shards, prev, dt=2.0)
+    assert "epoch 3" in frame
+    assert "500 tps budget" in frame
+    assert "storage_queue" in frame and "ss-0-r1" in frame
+    assert "hot range b'hot/key'" in frame
+    assert "[E-BRAKE]" in frame
+    assert "DD FROZEN" in frame and "2 hot relocation(s)" in frame
+    assert "10 commit/s" in frame  # (100-80)/2.0
+    assert "shards (hottest first, sampled)" in frame
+    # hottest shard sorts first
+    assert frame.index("b''") < frame.index("b'\\x80'")
+    assert "message [30] e_brake" in frame
+
+
+def test_fdbtop_render_empty_doc():
+    """A frame from an empty doc (connection just established) renders
+    without crashing — the monitor must survive a mid-recovery scrape."""
+    frame = render({}, [], None, 0.0)
+    assert "fdbtpu top" in frame
