@@ -798,6 +798,49 @@ def _cpu_phase_main() -> None:
     }))
 
 
+def _phase_profile_probe(*, cpu: bool) -> dict | None:
+    """Run phase_timings.py --json in a subprocess and return the parsed
+    phase report (the kernel.phase_profile block — per-phase walls, LSM
+    amortization and the merge-impl shootout), or None.
+
+    BENCH_PHASE_PROFILE: "small" (default) runs reduced shapes so the
+    probe fits the budget; "full" runs the probe.log-grade shapes (the
+    BENCH_r* artifact path); "0" disables.  Budgeted by
+    BENCH_PHASE_PROFILE_TIMEOUT (seconds)."""
+    import subprocess
+
+    mode = os.environ.get("BENCH_PHASE_PROFILE", "small")
+    if mode == "0":
+        return None
+    budget = float(os.environ.get("BENCH_PHASE_PROFILE_TIMEOUT", "900"))
+    args = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "phase_timings.py"),
+        "--json", "-",
+    ]
+    if mode != "full":
+        args.append("--small")
+    env = {**os.environ}
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            args, capture_output=True, text=True, timeout=budget, env=env
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("PHASE_PROFILE "):
+                return json.loads(line[len("PHASE_PROFILE "):])
+        print(
+            f"[bench] phase profile pass produced no report "
+            f"(rc={proc.returncode})",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 — the profile is additive data
+        print(f"[bench] phase profile pass failed: {e!r}", file=sys.stderr)
+    return None
+
+
 def _cpu_phase_probe() -> dict | None:
     """Run _cpu_phase_main in a subprocess (budgeted, opt-out with
     BENCH_CPU_PHASE=0) and return its parsed JSON, or None."""
@@ -875,6 +918,10 @@ def main() -> None:
         # block (measure in-process only if that pass failed)
         wire = (kern or {}).pop("commit_wire", None) or _commit_wire_probe()
         pcache = (kern or {}).pop("page_cache", None) or _page_cache_probe()
+        profile = _phase_profile_probe(cpu=True)
+        if profile is not None:
+            kern = kern or {}
+            kern["phase_profile"] = profile
         _emit(
             "occ_conflict_checks_per_sec_native_cpu_64k_live_ranges",
             native_rate,
@@ -913,8 +960,13 @@ def main() -> None:
 # row gathers (r3/r4 measurements).  Override with FDBTPU_SEARCH_IMPL /
 # FDBTPU_MERGE_IMPL / FDBTPU_LSM / FDBTPU_INCREMENTAL / FDBTPU_PALLAS, or
 # set BENCH_AUTOTUNE=1 to re-measure all combos on the live device.
-# Tuple: (search_impl, merge_impl, lsm, incremental).
-BEST_KNOWN = ("bucket", "sort", True, True)
+# Tuple: (search_impl, merge_impl, lsm, incremental).  merge="scatter" per
+# the r05-session shootout (recent 2^17: 130.9->55.3 ms, main 2^19:
+# 671.3->179.2 ms over the sort fold; re-confirmed post-adoption in
+# .bench_state/probe.log) — the fold recipe now also drives the deferred
+# k-way compaction and the run-append union, so the dimension matters on
+# the incremental path too.
+BEST_KNOWN = ("bucket", "scatter", True, True)
 
 
 def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool, bool]:
@@ -957,14 +1009,15 @@ def _autotune(backend, prefill, timed, pool_words) -> tuple[str, str, bool, bool
     # time-boxed autotune (flaky tunnel insurance) that stops early still
     # lands on a good configuration.
     combos = [
-        ("bucket", "sort", True, True),     # incremental + cached-table main
-        ("sort", "sort", True, True),       # exact sort search, incremental
-        ("bucket", "sort", False, True),    # incremental over flat main
-        ("bucket", "gather", True, False),  # legacy per-batch merges below
+        ("bucket", "scatter", True, True),  # incremental + scatter folds
+        ("bucket", "sort", True, True),     # incremental + sort folds
+        ("bucket", "gather", True, True),   # incremental + gather folds
+        ("sort", "scatter", True, True),    # exact sort search, incremental
+        ("bucket", "scatter", False, True),  # incremental over flat main
+        ("bucket", "scatter", True, False),  # legacy per-batch merges below
+        ("bucket", "gather", True, False),
         ("bucket", "sort", True, False),
-        ("sort", "gather", True, False),
         ("bucket", "sort", False, False),
-        ("bucket", "scatter", True, False),
     ]
     budget_s = float(os.environ.get("BENCH_AUTOTUNE_BUDGET_S", "900"))
     t_start = time.perf_counter()
@@ -1118,7 +1171,11 @@ def _device_run(backend, prefill, timed, post, pool_words, nat_verdicts,
         "full_merges": snap["full_merges"],
         "incremental": bool(getattr(dev, "_incremental", False)),
         "probe_impl": getattr(dev, "_probe_impl", "?"),
+        "merge_impl": getattr(dev, "_merge_impl", "?"),
     }
+    profile = _phase_profile_probe(cpu=(backend == "cpu"))
+    if profile is not None:
+        kernel["phase_profile"] = profile
     if getattr(dev, "_incremental", False):
         # only the incremental path honors _phase_timing; a legacy-config
         # run must not report a zeroed split as a measured one

@@ -104,6 +104,11 @@ class KernelStats:
     rows_reclaimed: int = 0     # boundaries freed by GC/compaction
     runs_appended: int = 0      # incremental merge: batches appended as runs
     full_merges: int = 0        # legacy path: full per-batch state rewrites
+    merge_impl: str = "?"       # fold implementation (sort|scatter|gather)
+    # wall seconds spent in run/recent→main folds keyed by the merge impl
+    # that executed them — lets the status plane show which impl is live
+    # AND what each impl actually cost when an autotune sweep mixes them.
+    fold_wall_s: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self) -> None:
         # per-batch resolve-time reservoir for p50/p99 (deterministic
@@ -139,6 +144,8 @@ class KernelStats:
             "node_count": node_count,
             "runs_appended": self.runs_appended,
             "full_merges": self.full_merges,
+            "merge_impl": self.merge_impl,
+            "fold_ms": {k: v * 1e3 for k, v in sorted(self.fold_wall_s.items())},
             "pack_ms": self.pack_s * 1e3,
             "encode_ms": self.encode_s * 1e3,
             "pad_ms": self.pad_s * 1e3,

@@ -69,7 +69,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import keys as keymod
-from ..ops.rmq import I32_MAX, _levels, build_sparse_table, query_sparse_table
+from ..ops.rmq import (
+    I32_MAX,
+    _levels,
+    build_sparse_table,
+    query_sparse_table,
+    range_update_point_query,
+)
 from ..ops.search import lex_less
 from . import pallas_kernel
 from .api import ConflictSet, KernelStats, TxInfo, Verdict, validate_batch
@@ -122,16 +128,22 @@ _IMPL_CHOICES = {"search": ("bucket", "sort"), "merge": ("scatter", "sort", "gat
 _IMPL_ENV = {"search": "FDBTPU_SEARCH_IMPL", "merge": "FDBTPU_MERGE_IMPL"}
 
 
+_IMPL_DEFAULTS = {"search": "sort", "merge": "scatter"}
+
+
 def impl_from_env(kind: str, override: str | None = None) -> str:
     """Resolve the search/merge implementation choice: explicit override,
-    else FDBTPU_{KIND}_IMPL, else "sort" (the TPU-friendly default — XLA's
-    TPU scatters/gathers lower to serial per-row loops while sorts are tuned
-    networks; bench.py autotunes the final pick on the live device).  A
-    single source of truth so the device, sharded and bench paths cannot
-    drift; unknown values fail loudly."""
+    else FDBTPU_{KIND}_IMPL, else the measured per-kind default.  Merge
+    defaults to "scatter": the PR-16 shootout (.bench_state/probe.log)
+    measured the scatter merge 2.4-3.7x faster than the shipped sort merge
+    at bench shapes (recent 2^17: 130.9->55.3 ms, main 2^19:
+    671.3->179.2 ms), so the measured winner ships as the default and
+    sort/gather stay behind FDBTPU_MERGE_IMPL as parity referees and an
+    autotune dimension.  A single source of truth so the device, sharded
+    and bench paths cannot drift; unknown values fail loudly."""
     import os
 
-    v = override or os.environ.get(_IMPL_ENV[kind], "sort")
+    v = override or os.environ.get(_IMPL_ENV[kind], _IMPL_DEFAULTS[kind])
     if v not in _IMPL_CHOICES[kind]:
         raise ValueError(
             f"unknown {kind}_impl {v!r}; choose one of {_IMPL_CHOICES[kind]}"
@@ -266,14 +278,14 @@ def phase_history(vs, g_lo, g_hi, snap, r_idx, r_ok, n_txn: int):
     return jnp.zeros(n_txn, jnp.int32).at[r_idx].add(r_hist.astype(jnp.int32)) > 0
 
 
-def phase_intra(rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active,
-                hist, n_txn: int):
-    """Intra-batch conflicts (replaces MiniConflictSet :1028-1152).  The
-    reference's ordered bitmask walk is inherently sequential (later txns
-    see earlier *committed* txns' writes); solved as a fixpoint over a dense
-    [R, Wn] overlap predicate evaluated in a batch-local dense rank space —
-    recomputed inside the reduce each iteration, so nothing R×Wn is ever
-    materialized in HBM.  Returns (intra, n_iters)."""
+def phase_intra_dense(rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active,
+                      hist, n_txn: int):
+    """Dense-referee intra fixpoint (the pre-rank-space formulation): the
+    [R, Wn] overlap predicate recomputed inside the reduce each iteration.
+    O(R*Wn) per iteration — the measured 527.9 ms/batch dominator at bench
+    shapes (.bench_state/probe.log) — kept as the parity referee for
+    phase_intra below, which evaluates the identical per-iteration map in
+    rank space.  Returns (intra, n_iters)."""
     B, R, Wn = n_txn, rb.shape[0], wb.shape[0]
     lr = _local_ranks(jnp.concatenate([rb, re_, wb, we], axis=0))
     rb_r, re_r = lr[:R], lr[R : 2 * R]
@@ -289,6 +301,86 @@ def phase_intra(rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active,
         minw = jnp.min(
             jnp.where(ov, w_cand[None, :], I32_MAX), axis=1
         )  # earliest committed writer overlapping each read
+        minw = jnp.where(r_ok, minw, I32_MAX)
+        tx_minw = jnp.full(B, I32_MAX, jnp.int32).at[r_idx].min(minw)
+        new_intra = tx_minw < tx_iota  # strictly-earlier committed writer
+        changed = jnp.any(new_intra != intra)
+        return new_intra, changed, it + 1
+
+    def _cond(state):
+        _, changed, it = state
+        return changed & (it < B + 2)
+
+    intra, _, n_iters = jax.lax.while_loop(
+        _cond, _body, (jnp.zeros(B, bool), jnp.asarray(True), jnp.int32(0))
+    )
+    return intra, n_iters
+
+
+def phase_intra(rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active,
+                hist, n_txn: int, impl: str = "xla"):
+    """Intra-batch conflicts (replaces MiniConflictSet :1028-1152), in RANK
+    space.  Same fixpoint as phase_intra_dense — per iteration, minw(r) =
+    min txn index over committed writers overlapping read r, then "txn t
+    conflicts iff a strictly earlier committed txn writes a range t reads"
+    — but the overlap reduce is evaluated against the batch-local endpoint
+    ranks instead of a dense [R, Wn] predicate:
+
+      * all 2R+2Wn endpoints rank once (`_local_ranks`, one lexsort);
+        live ranges are non-empty (the pack paths drop b >= e), so
+        overlap (wb < re and rb < we) partitions EXACTLY by where the
+        writer begins relative to the read:
+      * case 1 — rb_r < wb_r < re_r (writer begins strictly inside the
+        read): a min-sparse-table over writer begins answers the range-min
+        on ranks (rb_r, re_r) per read;
+      * case 2 — wb_r <= rb_r < we_r (writer covers the read's begin): a
+        block-decomposition stab (ops/rmq.py range_update_point_query)
+        answers the min over write intervals containing rank rb_r.
+
+    minw = min(case1, case2) is elementwise equal to the dense reduce, so
+    the fixpoint trajectory, iteration count and verdicts are BIT-IDENTICAL
+    to the referee (pinned in tests/test_pallas.py).  Per-iteration cost is
+    O(n log n) scans/scatters with n = 2R+2Wn instead of the dense R*Wn —
+    the measured 527.9 ms/batch at bench shapes drops to sparse-table
+    build + stab cost (docs/KERNEL.md has the before/after table).
+
+    `impl`: "xla" (default) evaluates the two queries inline; "tpu" /
+    "interpret" routes the per-read min query through the fused Pallas
+    kernel (conflict/pallas_kernel.py intra_query) with explicit VMEM
+    staging of the rank tables — the same capability probe as the run
+    probe.  Returns (intra, n_iters)."""
+    B, R, Wn = n_txn, rb.shape[0], wb.shape[0]
+    lr = _local_ranks(jnp.concatenate([rb, re_, wb, we], axis=0))
+    rb_r, re_r = lr[:R], lr[R : 2 * R]
+    wb_r, we_r = lr[2 * R : 2 * R + Wn], lr[2 * R + Wn :]
+    n = 2 * (R + Wn)
+    tx_iota = jnp.arange(B, dtype=jnp.int32)
+    # non-empty in rank space; also guards the stab against inverted rows
+    w_span = wb_r < we_r
+
+    def _body(state):
+        intra, _, it = state
+        committed = active & ~hist & ~intra
+        w_com = w_ok & committed[w_idx]
+        w_cand = jnp.where(w_com, w_tx, I32_MAX)  # [Wn]
+        # case 1: min candidate txn at each begin rank (idempotent min —
+        # duplicate begin ranks collapse; dead writers carry I32_MAX)
+        begs = jnp.full(n, I32_MAX, jnp.int32).at[wb_r].min(w_cand)
+        beg_tab = build_sparse_table(begs, jnp.minimum, I32_MAX)
+        # case 2: stab structure — point g holds the min candidate over
+        # live write intervals [wb_r, we_r) containing g
+        stab = range_update_point_query(
+            n, wb_r, we_r, w_cand, w_com & w_span, "min", I32_MAX
+        )
+        if impl == "xla":
+            case1 = query_sparse_table(
+                beg_tab, rb_r + 1, re_r, jnp.minimum, I32_MAX
+            )
+            minw = jnp.minimum(case1, jnp.take(stab, rb_r))
+        else:
+            minw = pallas_kernel.intra_query(
+                beg_tab, stab, rb_r, re_r, impl=impl
+            )
         minw = jnp.where(r_ok, minw, I32_MAX)
         tx_minw = jnp.full(B, I32_MAX, jnp.int32).at[r_idx].min(minw)
         new_intra = tx_minw < tx_iota  # strictly-earlier committed writer
@@ -726,7 +818,7 @@ def resolve_core_lsm(
     search_iters: int = FAST_SEARCH_ITERS,
     rec_iters: int = FAST_SEARCH_ITERS,
     search_impl: str = "bucket",
-    merge_impl: str = "sort",
+    merge_impl: str = "scatter",
 ):
     """LSM twin of resolve_core.  Per batch: read-search on main (cached
     bucket index, or the exact sort twin), full search on recent, history =
@@ -816,15 +908,13 @@ def _ffill(defined, vals):
     return jnp.where(d, v, 0)
 
 
-def compact_lsm(ks, vs, rec_ks, rec_vs, *, cap: int):
-    """Fold recent into main: ONE multiword sort of both levels, per-source
-    forward-fills (associative scans) to evaluate each step function on the
-    merged domain, max-compose, coalesce equal-valued neighbours, and
-    compact with a stable 1-bit sort — the same scatter-free recipe as
-    phase_merge_sort, generalized to two full step functions.
-
-    Returns (new_ks, new_vs, new_count, new_bidx, new_tab); if new_count >
-    cap the caller must regrow main and re-run (inputs are not donated)."""
+def _compact_fold_sort(ks, vs, rec_ks, rec_vs, *, cap: int):
+    """Sort-based fold (the referee): ONE multiword sort of both levels,
+    per-source forward-fills (associative scans) to evaluate each step
+    function on the merged domain, max-compose, coalesce equal-valued
+    neighbours, and compact with a stable 1-bit sort — the same
+    scatter-free recipe as phase_merge_sort, generalized to two full step
+    functions.  Returns (new_ks, new_vs, new_count)."""
     rec_cap = rec_ks.shape[0]
     W = ks.shape[1]
     M = cap + rec_cap
@@ -853,6 +943,163 @@ def compact_lsm(ks, vs, rec_ks, rec_vs, *, cap: int):
     srt2 = jax.lax.sort(ops2, num_keys=1, is_stable=True)
     new_ks = jnp.stack(srt2[1 : 1 + W], axis=1)[:cap]
     new_vs = srt2[1 + W][:cap]
+    return new_ks, new_vs, new_count
+
+
+def _compact_ub(ks, rec_ks, *, cap: int):
+    """#main rows <= rec_ks[j], per recent row — the cross ranks the
+    scatter/gather folds build their merge-path positions from.  ONE
+    full-depth binary search of the rec rows into main (the (words, len+1)
+    upper-bound trick; exact, no bucket index needed).  Sentinel rec rows
+    wrap their length lane and rank garbage — callers mask dead rows."""
+    rec_plus = rec_ks.at[:, -1].add(1)
+    ub, _ = _bucketed_lower_bound(
+        ks, jnp.zeros(1, jnp.int32), jnp.int32(cap), rec_plus, _levels(cap)
+    )
+    return ub
+
+
+def _compact_fold_scatter(ks, vs, rec_ks, rec_vs, *, cap: int, ub=None):
+    """Scatter-based fold — the ADOPTED default (PR-16 shootout: 2.4-3.7x
+    over the sort fold at bench shapes on the measured backend).  Instead
+    of sorting cap+rec_cap rows by W+1 keys, ONE binary search ranks the
+    recent rows into main (`_compact_ub`, or a Pallas lowering via `ub`),
+    merge-path positions come from an arange + cumsum (the phase_merge
+    recipe applied to two full step functions), and the merged domain is
+    built with plain row scatters.  Value composition (per-source forward
+    fill + max) and coalescing are shared with the sort fold, so the
+    outputs are bit-identical — pinned by the merge-impl parity sweep.
+    Returns (new_ks, new_vs, new_count)."""
+    rec_cap = rec_ks.shape[0]
+    W = ks.shape[1]
+    M = cap + rec_cap
+    rec_live = ~_is_sentinel(rec_ks)
+    if ub is None:
+        ub = _compact_ub(ks, rec_ks, cap=cap)
+    # rec row j lands between main rows ub[j]-1 and ub[j] (main-first on
+    # equal keys); #rec rows before main row i is a prefix count of ub
+    cnt = jnp.zeros(cap, jnp.int32).at[
+        jnp.where(rec_live, ub, cap)
+    ].add(1, mode="drop")
+    pos_main = jnp.arange(cap, dtype=jnp.int32) + jnp.cumsum(cnt)
+    pos_rec = jnp.where(
+        rec_live, jnp.arange(rec_cap, dtype=jnp.int32) + ub, M
+    )
+    merged = (
+        jnp.full((M, W), _SENT_WORD, jnp.uint32)
+        .at[pos_main].set(ks, mode="drop")
+        .at[pos_rec].set(rec_ks, mode="drop")
+    )
+    main_def = jnp.zeros(M, bool).at[pos_main].set(True, mode="drop")
+    rec_def = jnp.zeros(M, bool).at[pos_rec].set(True, mode="drop")
+    mval = jnp.zeros(M, jnp.int32).at[pos_main].set(vs, mode="drop")
+    rval = jnp.zeros(M, jnp.int32).at[pos_rec].set(rec_vs, mode="drop")
+    main_f = _ffill(main_def, mval)
+    rec_f = _ffill(rec_def, rval)
+    val = jnp.maximum(main_f, rec_f)
+
+    sent = _is_sentinel(merged)
+    keep = ~sent & jnp.concatenate([jnp.array([True]), val[1:] != val[:-1]])
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    pos = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1, M)
+    new_ks = jnp.full((cap, W), _SENT_WORD, jnp.uint32).at[pos].set(
+        merged, mode="drop"
+    )
+    new_vs = jnp.zeros(cap, jnp.int32).at[pos].set(val, mode="drop")
+    return new_ks, new_vs, new_count
+
+
+def _compact_fold_gather(ks, vs, rec_ks, rec_vs, *, cap: int, ub=None):
+    """Gather-formulated fold (the scatter-free/full-sort-free twin, same
+    shape as phase_merge_gather): the cross ranks imply every output
+    position, so the merged domain is CONSTRUCTED by row gathers — rec
+    positions are strictly increasing, one searchsorted recovers "#rec
+    rows at merged positions <= p", and compaction reuses the stable
+    1-bit scalar sort + gather trick.  Returns (new_ks, new_vs,
+    new_count)."""
+    rec_cap = rec_ks.shape[0]
+    W = ks.shape[1]
+    M = cap + rec_cap
+    rec_live = ~_is_sentinel(rec_ks)
+    if ub is None:
+        ub = _compact_ub(ks, rec_ks, cap=cap)
+    # dead rec rows (a suffix) pad past M so the domain stays sorted
+    pos_rec = jnp.where(
+        rec_live,
+        jnp.arange(rec_cap, dtype=jnp.int32) + ub,
+        M + jnp.arange(rec_cap, dtype=jnp.int32),
+    )
+    nb = jnp.searchsorted(
+        pos_rec, jnp.arange(M, dtype=jnp.int32), side="right", method="sort"
+    ).astype(jnp.int32)
+    prev_nb = jnp.concatenate([jnp.zeros(1, jnp.int32), nb[:-1]])
+    is_rec = nb > prev_nb
+    rec_i = jnp.clip(nb - 1, 0, rec_cap - 1)
+    main_i_raw = jnp.arange(M, dtype=jnp.int32) - nb
+    oob = main_i_raw >= cap        # only past every live row (see fold proof)
+    main_i = jnp.clip(main_i_raw, 0, cap - 1)
+    row_rec = jnp.take(rec_ks, rec_i, axis=0)
+    row_main = jnp.take(ks, main_i, axis=0)
+    merged = jnp.where(is_rec[:, None], row_rec, row_main)
+    sent = ~is_rec & (oob | (jnp.take(ks[:, -1], main_i) == _SENT_WORD))
+    main_f = _ffill(~is_rec, jnp.take(vs, main_i))
+    rec_f = _ffill(is_rec, jnp.take(rec_vs, rec_i))
+    val = jnp.maximum(main_f, rec_f)
+
+    keep = ~sent & jnp.concatenate([jnp.array([True]), val[1:] != val[:-1]])
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    kperm = jax.lax.sort(
+        ((~keep).astype(jnp.uint32), jnp.arange(M, dtype=jnp.int32)),
+        num_keys=1, is_stable=True,
+    )[1][:cap]
+    q_live = jnp.arange(cap) < new_count
+    sel_rec = jnp.take(is_rec, kperm)
+    out_rec = jnp.take(rec_ks, jnp.take(rec_i, kperm), axis=0)
+    out_main = jnp.take(ks, jnp.take(main_i, kperm), axis=0)
+    sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
+    new_ks = jnp.where(
+        q_live[:, None],
+        jnp.where(sel_rec[:, None], out_rec, out_main),
+        sent_row[None, :],
+    )
+    new_vs = jnp.where(q_live, jnp.take(val, kperm), 0)
+    return new_ks, new_vs, new_count
+
+
+_COMPACT_FOLDS = {
+    "scatter": _compact_fold_scatter,
+    "sort": _compact_fold_sort,
+    "gather": _compact_fold_gather,
+}
+
+
+def compact_lsm(ks, vs, rec_ks, rec_vs, *, cap: int,
+                merge_impl: str = "scatter", lowering: str = "xla"):
+    """Fold recent into main — the deferred k-way merge's inner step and
+    the LSM compaction.  `merge_impl` selects the fold recipe (scatter is
+    the adopted default; sort/gather are the bit-identical parity referees
+    behind FDBTPU_MERGE_IMPL).  `lowering` = "tpu" | "interpret" routes the
+    cross-rank search through the Pallas rank kernel
+    (conflict/pallas_kernel.py compact_ranks) with VMEM-staged key blocks;
+    "xla" (default) uses the inline binary search.
+
+    Returns (new_ks, new_vs, new_count, new_bidx, new_tab); if new_count >
+    cap the caller must regrow main and re-run (inputs are not donated)."""
+    if merge_impl not in _COMPACT_FOLDS:
+        raise ValueError(f"unknown merge_impl {merge_impl!r}")
+    if merge_impl == "sort":
+        new_ks, new_vs, new_count = _compact_fold_sort(
+            ks, vs, rec_ks, rec_vs, cap=cap
+        )
+    else:
+        ub = (
+            pallas_kernel.compact_ranks(ks, rec_ks, impl=lowering)
+            if lowering != "xla"
+            else None
+        )
+        new_ks, new_vs, new_count = _COMPACT_FOLDS[merge_impl](
+            ks, vs, rec_ks, rec_vs, cap=cap, ub=ub
+        )
     new_bidx = _rebuild_buckets(new_ks)
     new_tab = build_sparse_table(new_vs, jnp.maximum, 0)
     return new_ks, new_vs, new_count, new_bidx, new_tab
@@ -866,7 +1113,9 @@ _resolve_lsm_kernel = functools.partial(
     ),
 )(resolve_core_lsm)
 
-_compact_kernel = functools.partial(jax.jit, static_argnames=("cap",))(compact_lsm)
+_compact_kernel = functools.partial(
+    jax.jit, static_argnames=("cap", "merge_impl", "lowering")
+)(compact_lsm)
 
 
 # ---------------------------------------------------------------------------
@@ -890,14 +1139,18 @@ _compact_kernel = functools.partial(jax.jit, static_argnames=("cap",))(compact_l
 # elsewhere), so the fold is the existing max-compose.
 
 
-def _union_intervals(wb, we, w_ins, *, run_cap: int):
+def _union_intervals(wb, we, w_ins, *, run_cap: int,
+                     merge_impl: str = "scatter"):
     """Canonical disjoint interval union of the committed writes, compacted
     to the front and sentinel-padded to run_cap — the payload the
     incremental path appends as one run.  ONE 2Wn-row multiword sort finds
     coverage transitions (begins sort before equal ends so adjacent
-    intervals coalesce), then two stable 1-bit sorts compact the begin/end
-    rows; pairwise aligned by construction (the j-th begin opens the
-    interval the j-th end closes).  Returns (u_b, u_e)."""
+    intervals coalesce), then the begin/end rows compact via a cumsum +
+    row scatter (merge_impl="scatter", the adopted default — the 1-bit
+    stable sorts were the sort-scan append's remaining full-width sorts)
+    or the original two stable 1-bit sorts (parity referees); pairwise
+    aligned by construction (the j-th begin opens the interval the j-th
+    end closes).  Returns (u_b, u_e)."""
     Wn, W = wb.shape
     n = 2 * Wn
     sent_row = jnp.full((W,), _SENT_WORD, jnp.uint32)
@@ -920,13 +1173,21 @@ def _union_intervals(wb, we, w_ins, *, run_cap: int):
     is_beg = (cov > 0) & (prev <= 0)
     is_end = (cov <= 0) & (prev > 0)
 
-    def compact(mask):
-        mrows = jnp.where(mask[:, None], srows, sent_row[None, :])
-        ops2 = ((~mask).astype(jnp.uint32),) + tuple(
-            mrows[:, w] for w in range(W)
-        )
-        s2 = jax.lax.sort(ops2, num_keys=1, is_stable=True)
-        return jnp.stack(s2[1 : 1 + W], axis=1)
+    if merge_impl == "sort" or merge_impl == "gather":
+        def compact(mask):
+            mrows = jnp.where(mask[:, None], srows, sent_row[None, :])
+            ops2 = ((~mask).astype(jnp.uint32),) + tuple(
+                mrows[:, w] for w in range(W)
+            )
+            s2 = jax.lax.sort(ops2, num_keys=1, is_stable=True)
+            return jnp.stack(s2[1 : 1 + W], axis=1)
+    else:
+        def compact(mask):
+            pos = jnp.where(mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, n)
+            return (
+                jnp.full((n, W), _SENT_WORD, jnp.uint32)
+                .at[pos].set(srows, mode="drop")
+            )
 
     u_b, u_e = compact(is_beg), compact(is_end)
     if n < run_cap:
@@ -971,19 +1232,31 @@ def inc_check(hist_base, g_lo, g_hi, rb, re_, r_tx, wb, we, w_tx,
     r_idx = jnp.clip(r_tx, 0, B - 1)
     w_ok = (w_tx >= 0) & ~_is_sentinel(wb)
     w_idx = jnp.clip(w_tx, 0, B - 1)
-    if from_table:
-        hist = history_from_table(hist_base, g_lo, g_hi, snap, r_idx, r_ok, B)
-    else:
-        hist = phase_history(hist_base, g_lo, g_hi, snap, r_idx, r_ok, B)
-    run_r = pallas_kernel.run_conflicts(
-        rb, re_, snap[r_idx], r_ok, runs_b, runs_e, runs_ver, impl=probe_impl
+    # Per-READ history bits instead of a txn-level pre-reduce: the
+    # main-level range-max and the run probe fuse into ONE pass over the
+    # reads — run_conflicts_fused ORs the history bit inside the sort-scan
+    # grid (Pallas) or the vmapped fallback — and the combined bits scatter
+    # to txn level exactly once.  Same final bits as phase_history |
+    # run-probe (OR of scatters == scatter of ORs).
+    tab = (
+        hist_base if from_table
+        else build_sparse_table(hist_base, jnp.maximum, 0)
     )
-    hist = hist | (
-        jnp.zeros(B, jnp.int32).at[r_idx].add((r_ok & run_r).astype(jnp.int32))
+    read_max = query_sparse_table(tab, g_lo, g_hi, jnp.maximum, 0)
+    hist_r = r_ok & (read_max > snap[r_idx])
+    conf_r = pallas_kernel.run_conflicts_fused(
+        rb, re_, snap[r_idx], r_ok, runs_b, runs_e, runs_ver, hist_r,
+        impl=probe_impl,
+    )
+    hist = (
+        jnp.zeros(B, jnp.int32).at[r_idx].add((r_ok & conf_r).astype(jnp.int32))
         > 0
     )
+    # the intra min-queries ride the same capability probe as the run
+    # probe: Pallas on TPU, interpret for CPU parity, inline XLA otherwise
     intra, _n_iters = phase_intra(
-        rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active, hist, B
+        rb, re_, wb, we, r_ok, w_ok, r_idx, w_idx, w_tx, active, hist, B,
+        impl=probe_impl,
     )
     committed = active & ~hist & ~intra
     verdict = jnp.where(
@@ -995,11 +1268,13 @@ def inc_check(hist_base, g_lo, g_hi, rb, re_, r_tx, wb, we, w_tx,
 
 
 def inc_append(runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
-               *, run_cap: int):
+               *, run_cap: int, merge_impl: str = "scatter"):
     """Phase "merge": append this batch's canonical committed union as run
     `slot` — a dynamic-update-slice of O(run_cap) rows, NOT a full-state
     rewrite.  Returns (runs_b', runs_e', runs_ver')."""
-    u_b, u_e = _union_intervals(wb, we, w_ins, run_cap=run_cap)
+    u_b, u_e = _union_intervals(
+        wb, we, w_ins, run_cap=run_cap, merge_impl=merge_impl
+    )
     new_b = jax.lax.dynamic_update_slice(runs_b, u_b[None], (slot, 0, 0))
     new_e = jax.lax.dynamic_update_slice(runs_e, u_e[None], (slot, 0, 0))
     return new_b, new_e, runs_ver.at[slot].set(commit_off)
@@ -1014,6 +1289,7 @@ def resolve_core_inc(
     search_iters: int = FAST_SEARCH_ITERS,
     search_impl: str = "bucket",
     probe_impl: str = "xla",
+    merge_impl: str = "scatter",
 ):
     """Incremental twin of resolve_core: main level is READ-ONLY per batch
     (searched for history only), committed writes append as a run, and the
@@ -1030,7 +1306,7 @@ def resolve_core_inc(
     )
     new_b, new_e, new_ver = inc_append(
         runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
-        run_cap=run_cap,
+        run_cap=run_cap, merge_impl=merge_impl,
     )
     return verdict, new_b, new_e, new_ver, conv, ok_in & conv
 
@@ -1044,6 +1320,7 @@ def resolve_core_inc_lsm(
     search_iters: int = FAST_SEARCH_ITERS,
     search_impl: str = "bucket",
     probe_impl: str = "xla",
+    merge_impl: str = "scatter",
 ):
     """LSM twin of resolve_core_inc: main history from the CACHED sparse
     table (rebuilt only at compaction); the run layer plays the recent
@@ -1059,17 +1336,21 @@ def resolve_core_inc_lsm(
     )
     new_b, new_e, new_ver = inc_append(
         runs_b, runs_e, runs_ver, slot, wb, we, w_ins, commit_off,
-        run_cap=run_cap,
+        run_cap=run_cap, merge_impl=merge_impl,
     )
     return verdict, new_b, new_e, new_ver, conv, ok_in & conv
 
 
-def run_to_step(u_b, u_e, ver):
+def run_to_step(u_b, u_e, ver, *, impl: str = "xla"):
     """View one run as a step function: boundaries = interleaved begin/end
     keys (sorted, since b_0 < e_0 < b_1 < ...), gap values = ver over the
     run's intervals and 0 elsewhere.  Feeds compact_lsm directly — the
     deferred k-way merge is the existing two-level max-compose, applied
-    once per live run at compaction time."""
+    once per live run at compaction time.  `impl` = "tpu" | "interpret"
+    routes the interleave through the Pallas lowering (same capability
+    probe as the run probe)."""
+    if impl != "xla":
+        return pallas_kernel.run_to_step_pallas(u_b, u_e, ver, impl=impl)
     rcap, W = u_b.shape
     rows = jnp.stack([u_b, u_e], axis=1).reshape(2 * rcap, W)
     beg_live = ~_is_sentinel(u_b)
@@ -1085,7 +1366,7 @@ def run_to_step(u_b, u_e, ver):
 
 _inc_statics = (
     "cap", "run_cap", "n_txn", "n_read", "n_write", "search_iters",
-    "search_impl", "probe_impl",
+    "search_impl", "probe_impl", "merge_impl",
 )
 _resolve_inc_kernel = functools.partial(
     jax.jit, static_argnames=_inc_statics
@@ -1105,9 +1386,11 @@ _inc_check_kernel = functools.partial(
     jax.jit, static_argnames=("n_txn", "probe_impl", "from_table")
 )(inc_check)
 _inc_append_kernel = functools.partial(
-    jax.jit, static_argnames=("run_cap",)
+    jax.jit, static_argnames=("run_cap", "merge_impl")
 )(inc_append)
-_run_step_kernel = jax.jit(run_to_step)
+_run_step_kernel = functools.partial(
+    jax.jit, static_argnames=("impl",)
+)(run_to_step)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -1387,7 +1670,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         *,
         max_key_bytes: int = keymod.DEFAULT_MAX_KEY_BYTES,
         capacity: int = 1 << 16,
-        merge_impl: str | None = None,   # None: FDBTPU_MERGE_IMPL env or "sort"
+        merge_impl: str | None = None,   # None: FDBTPU_MERGE_IMPL env or "scatter"
         search_impl: str | None = None,  # None: FDBTPU_SEARCH_IMPL env or "sort"
         lsm: bool | None = None,         # None: FDBTPU_LSM env ("1") or False
         recent_capacity: int = 1 << 13,  # LSM recent-level capacity
@@ -1428,6 +1711,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         # recompile count is the number of DISTINCT static-shape combos the
         # jit cache has seen — the bucket-induced recompiles ISSUE cites
         self.stats = KernelStats(backend="device")
+        self.stats.merge_impl = self._merge_impl
         self._compiled_shapes: set[tuple] = set()
         self._pipeline_init()  # staging arenas + deferred-resolve window
         self._init_state(capacity)
@@ -1862,13 +2146,14 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         statics = dict(
             cap=self._cap, run_cap=self._run_cap, n_txn=Bp, n_read=R,
             n_write=Wn, search_impl=self._search_impl,
-            probe_impl=self._probe_impl,
+            probe_impl=self._probe_impl, merge_impl=self._merge_impl,
         )
 
         def dispatch(ok_in, iters):
             self._note_shape(
                 ("inc", self._lsm, self._cap, self._run_cap, self._K,
-                 Bp, R, Wn, iters, self._search_impl, self._probe_impl)
+                 Bp, R, Wn, iters, self._search_impl, self._probe_impl,
+                 self._merge_impl)
             )
             return kernel(
                 self._ks, hist_base, self._bidx, self._dev_count,
@@ -1957,6 +2242,7 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         nb, ne, nv = _inc_append_kernel(
             self._runs_b, self._runs_e, self._runs_ver, slot,
             wbv, wev, w_ins, commit_off, run_cap=self._run_cap,
+            merge_impl=self._merge_impl,
         )
         jax.block_until_ready(nv)
         self.stats.append_s += time.perf_counter() - t
@@ -1975,11 +2261,13 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         nc_i = self._count_ub
         for s in range(self._n_runs):
             rows, vals = _run_step_kernel(
-                self._runs_b[s], self._runs_e[s], self._runs_ver[s]
+                self._runs_b[s], self._runs_e[s], self._runs_ver[s],
+                impl=self._probe_impl,
             )
             while True:
                 nk, nv, nc, nb, nt = _compact_kernel(
-                    self._ks, self._vs, rows, vals, cap=self._cap
+                    self._ks, self._vs, rows, vals, cap=self._cap,
+                    merge_impl=self._merge_impl, lowering=self._probe_impl,
                 )
                 nc_i = int(nc)
                 if nc_i <= self._cap:
@@ -1998,6 +2286,9 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         dt = time.perf_counter() - t0
         self.stats.compact_s += dt
         self.stats.merge_s += dt
+        self.stats.fold_wall_s[self._merge_impl] = (
+            self.stats.fold_wall_s.get(self._merge_impl, 0.0) + dt
+        )
         testcov("kernel.run_compaction")
 
     def _compact(self) -> None:
@@ -2006,7 +2297,8 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         before = self._count_ub + self._rec_count_ub
         while True:
             nk, nv, nc, nb, nt = _compact_kernel(
-                self._ks, self._vs, self._rec_ks, self._rec_vs, cap=self._cap
+                self._ks, self._vs, self._rec_ks, self._rec_vs, cap=self._cap,
+                merge_impl=self._merge_impl, lowering=self._probe_impl,
             )
             nc_i = int(nc)
             if nc_i <= self._cap:
@@ -2020,7 +2312,11 @@ class DeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         self.compactions += 1
         self.stats.compactions += 1
         self.stats.rows_reclaimed += max(0, before - nc_i)
-        self.stats.merge_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.merge_s += dt
+        self.stats.fold_wall_s[self._merge_impl] = (
+            self.stats.fold_wall_s.get(self._merge_impl, 0.0) + dt
+        )
         testcov("kernel.lsm_compaction")
 
     def _grow_main(self, new_cap: int) -> None:

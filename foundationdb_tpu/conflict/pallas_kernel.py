@@ -57,6 +57,8 @@ import jax.numpy as jnp
 
 READ_BLOCK = 128    # reads per grid step (R is power-of-two bucketed, >= 16)
 SUMMARY_STRIDE = 128  # begin keys per summary window (the coarse partition)
+I32_MAX = 0x7FFFFFFF  # ops.rmq identity, repeated here so kernel bodies
+#                       close over a Python int, not an imported device value
 
 
 # ---------------------------------------------------------------------------
@@ -125,6 +127,41 @@ def lex_less_b(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 # the kernel
 
 
+def _block_rank(rows, q, *, stride: int, nrows: int):
+    """Two-level rank scan shared by every kernel body here: #rows (sorted,
+    VMEM-resident [nrows, W]) lexicographically below each query row q
+    ([QB, W]) — a vectorized count against every stride-th row (the
+    merge-path coarse partition), then a counted compare inside the one
+    stride-wide window the rank can occupy.  Returns int32[QB]."""
+    n_sum = nrows // stride
+    wins = rows.reshape(n_sum, stride, rows.shape[-1])
+    summary = wins[:, 0, :]      # every stride-th key (merge-path posts)
+
+    # coarse scan: rank lives in window (coarse - 1); coarse == 0 means
+    # rank == 0 (rows[0] >= q).
+    coarse = jnp.sum(
+        lex_less_b(summary[None, :, :], q[:, None, :]).astype(jnp.int32),
+        axis=1,
+    )                            # [QB]
+    w_i = jnp.clip(coarse - 1, 0, n_sum - 1)
+    window = jnp.take(wins, w_i, axis=0)        # [QB, stride, W]
+    fine = jnp.sum(
+        lex_less_b(window, q[:, None, :]).astype(jnp.int32), axis=1
+    )
+    return jnp.where(coarse > 0, w_i * stride + fine, 0)
+
+
+def _probe_conf(ver, rb, re_, snap, rok, begins, ends, *, stride: int,
+                run_cap: int):
+    """One run's conflict bits for one read block (the sort-scan core)."""
+    rank = _block_rank(begins, re_, stride=stride, nrows=run_cap)
+    # ends are sorted (disjoint intervals), so the candidate with the
+    # largest end among begins < re is exactly ends[rank - 1]
+    e_last = jnp.take(ends, jnp.clip(rank - 1, 0, run_cap - 1), axis=0)
+    intersects = (rank > 0) & lex_less_b(rb, e_last)
+    return ((rok > 0) & intersects & (ver > snap)).astype(jnp.int32)
+
+
 def _probe_kernel(ver_ref, rb_ref, re_ref, snap_ref, rok_ref, b_ref, e_ref,
                   out_ref, *, stride: int, run_cap: int):
     """One (read-block, run) grid step of the sort-scan probe.
@@ -132,44 +169,40 @@ def _probe_kernel(ver_ref, rb_ref, re_ref, snap_ref, rok_ref, b_ref, e_ref,
     Grid is (R // READ_BLOCK, K) with the run axis MINOR, so each read
     block's output is produced by K consecutive steps and accumulated with
     the standard revisiting pattern (init at k == 0, OR afterwards)."""
-    import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     k = pl.program_id(1)
-    begins = b_ref[0]            # [run_cap, W] — this run's interval begins
-    ends = e_ref[0]              # [run_cap, W] — matching ends (also sorted)
-    rb = rb_ref[...]             # [QB, W]
-    re_ = re_ref[...]            # [QB, W]
-    snap = snap_ref[...]         # [QB]
-    rok = rok_ref[...]           # [QB] int32 0/1
-    ver = ver_ref[k]             # this run's commit-version offset (SMEM)
-
-    n_sum = run_cap // stride
-    wins = begins.reshape(n_sum, stride, begins.shape[-1])
-    summary = wins[:, 0, :]      # every stride-th begin key (merge-path posts)
-
-    # coarse scan: how many summary posts sort before re?  rank lives in
-    # window (coarse - 1); coarse == 0 means rank == 0 (begins[0] >= re).
-    coarse = jnp.sum(
-        lex_less_b(summary[None, :, :], re_[:, None, :]).astype(jnp.int32),
-        axis=1,
-    )                            # [QB]
-    w_i = jnp.clip(coarse - 1, 0, n_sum - 1)
-    window = jnp.take(wins, w_i, axis=0)        # [QB, stride, W]
-    fine = jnp.sum(
-        lex_less_b(window, re_[:, None, :]).astype(jnp.int32), axis=1
+    conf = _probe_conf(
+        ver_ref[k], rb_ref[...], re_ref[...], snap_ref[...], rok_ref[...],
+        b_ref[0], e_ref[0], stride=stride, run_cap=run_cap,
     )
-    rank = jnp.where(coarse > 0, w_i * stride + fine, 0)
-
-    # ends are sorted (disjoint intervals), so the candidate with the
-    # largest end among begins < re is exactly ends[rank - 1]
-    e_last = jnp.take(ends, jnp.clip(rank - 1, 0, run_cap - 1), axis=0)
-    intersects = (rank > 0) & lex_less_b(rb, e_last)
-    conf = ((rok > 0) & intersects & (ver > snap)).astype(jnp.int32)
 
     @pl.when(k == 0)
     def _init():
         out_ref[...] = conf
+
+    @pl.when(k > 0)
+    def _accum():
+        out_ref[...] = out_ref[...] | conf
+
+
+def _probe_fused_kernel(ver_ref, rb_ref, re_ref, snap_ref, rok_ref, hist_ref,
+                        b_ref, e_ref, out_ref, *, stride: int, run_cap: int):
+    """Fused history + probe grid step: identical sort-scan core, but the
+    per-read MAIN-level history bit (range-max vs snapshot, computed by the
+    caller) rides the k == 0 init — the combined conflict bits leave the
+    grid in one pass instead of a separate txn-level OR."""
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+    conf = _probe_conf(
+        ver_ref[k], rb_ref[...], re_ref[...], snap_ref[...], rok_ref[...],
+        b_ref[0], e_ref[0], stride=stride, run_cap=run_cap,
+    )
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = hist_ref[...] | conf
 
     @pl.when(k > 0)
     def _accum():
@@ -246,3 +279,242 @@ def run_conflicts(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver,
             interpret=(impl == "interpret"),
         )
     raise ValueError(f"unknown probe impl {impl!r}; choose tpu|interpret|xla")
+
+
+# ---------------------------------------------------------------------------
+# fused history + probe: the per-read main-level history bit enters the
+# sort-scan grid and ORs into the k == 0 init, so history + run conflicts
+# leave the kernel as ONE bit vector (inc_check scatters it to txn level
+# exactly once)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_probe_fused(K: int, run_cap: int, W: int, R: int, interpret: bool):
+    """Compile-cache the fused pallas_call for one (shape, mode) combo."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qb = min(READ_BLOCK, R)
+    stride = min(SUMMARY_STRIDE, run_cap)
+    grid = (R // qb, K)
+    kernel = functools.partial(
+        _probe_fused_kernel, stride=stride, run_cap=run_cap
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                    # runs_ver [K]
+            pl.BlockSpec((qb, W), lambda q, k: (q, 0)),               # rb
+            pl.BlockSpec((qb, W), lambda q, k: (q, 0)),               # re
+            pl.BlockSpec((qb,), lambda q, k: (q,)),                   # snap
+            pl.BlockSpec((qb,), lambda q, k: (q,)),                   # r_ok
+            pl.BlockSpec((qb,), lambda q, k: (q,)),                   # hist bits
+            pl.BlockSpec((1, run_cap, W), lambda q, k: (k, 0, 0)),    # begins
+            pl.BlockSpec((1, run_cap, W), lambda q, k: (k, 0, 0)),    # ends
+        ],
+        out_specs=pl.BlockSpec((qb,), lambda q, k: (q,)),
+        out_shape=jax.ShapeDtypeStruct((R,), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def run_conflicts_fused(rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver,
+                        hist_r, *, impl: str) -> jnp.ndarray:
+    """run_conflicts with the main-level history bit fused in: returns
+    bool[R] = hist_r | (r_ok & run-probe conflict).  `hist_r` is the
+    caller's per-read "range-max over covered gaps > snapshot" bit (already
+    r_ok-masked).  Contractually identical across all three lowerings."""
+    if impl == "xla":
+        return hist_r | run_conflicts_xla(
+            rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver
+        )
+    if impl in ("tpu", "interpret"):
+        K, run_cap, W = runs_b.shape
+        R = rb.shape[0]
+        fn = _build_probe_fused(K, run_cap, W, R, impl == "interpret")
+        out = fn(
+            runs_ver, rb, re_, snap_r, r_ok.astype(jnp.int32),
+            hist_r.astype(jnp.int32), runs_b, runs_e,
+        )
+        return out > 0
+    raise ValueError(f"unknown probe impl {impl!r}; choose tpu|interpret|xla")
+
+
+# ---------------------------------------------------------------------------
+# intra min-query kernel: the rank-space fixpoint's per-read reduce
+# (device.phase_intra).  Per read r: min over (a) the min-sparse-table of
+# writer-begin candidates on rank range (rb_r, re_r) and (b) the stab point
+# value at rb_r (write intervals containing the read's begin).  Both tables
+# are VMEM-staged whole — n = 2(R+Wn) ints and L*n table entries are a few
+# hundred KB at bench shapes.
+
+
+def _intra_kernel(tab_ref, stab_ref, lo_ref, hi_ref, out_ref, *, n: int):
+    """One read-block step: replicate ops.rmq.query_sparse_table's exact
+    two-gather semantics (empty range -> I32_MAX) + the stab gather."""
+    rbr = lo_ref[...]            # [QB] read-begin ranks
+    hi = hi_ref[...]             # [QB] read-end ranks (exclusive)
+    tab = tab_ref[...]           # [L, n] min-sparse-table of begin candidates
+    stab = stab_ref[...]         # [n] stab of covering-interval candidates
+    lo = rbr + 1
+    nonempty = hi > lo
+    length = jnp.maximum(hi - lo, 1)
+    k = jnp.int32(31) - jax.lax.clz(length.astype(jnp.int32))
+    pw = jnp.int32(1) << k
+    i1 = jnp.clip(lo, 0, n - 1)
+    i2 = jnp.clip(hi - pw, 0, n - 1)
+    flat = tab.reshape(-1)
+    a = jnp.take(flat, k * n + i1)
+    b = jnp.take(flat, k * n + i2)
+    case1 = jnp.where(nonempty, jnp.minimum(a, b), jnp.int32(I32_MAX))
+    case2 = jnp.take(stab, jnp.clip(rbr, 0, n - 1))
+    out_ref[...] = jnp.minimum(case1, case2)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_intra(L: int, n: int, R: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    qb = min(READ_BLOCK, R)
+    return pl.pallas_call(
+        functools.partial(_intra_kernel, n=n),
+        grid=(R // qb,),
+        in_specs=[
+            pl.BlockSpec((L, n), lambda q: (0, 0)),    # sparse table (VMEM)
+            pl.BlockSpec((n,), lambda q: (0,)),        # stab (VMEM)
+            pl.BlockSpec((qb,), lambda q: (q,)),       # rb ranks
+            pl.BlockSpec((qb,), lambda q: (q,)),       # re ranks
+        ],
+        out_specs=pl.BlockSpec((qb,), lambda q: (q,)),
+        out_shape=jax.ShapeDtypeStruct((R,), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def intra_query(beg_tab, stab, rb_r, re_r, *, impl: str) -> jnp.ndarray:
+    """minw[r] = min(range-min of beg_tab over (rb_r, re_r), stab[rb_r]) —
+    the fused per-read reduce of phase_intra's two-case decomposition.
+    Bit-identical to the inline XLA pair (query_sparse_table + take)."""
+    if impl not in ("tpu", "interpret"):
+        raise ValueError(f"unknown intra impl {impl!r}; choose tpu|interpret")
+    L, n = beg_tab.shape
+    R = rb_r.shape[0]
+    fn = _build_intra(L, n, R, impl == "interpret")
+    return fn(beg_tab, stab, rb_r, re_r)
+
+
+# ---------------------------------------------------------------------------
+# run -> step-function interleave (device.run_to_step's Pallas lowering):
+# trivially bandwidth-bound, but lowering it keeps the whole deferred-merge
+# chain on the same backend as the probe when a compaction fires on-device
+
+
+_SENT_WORD_P = 0xFFFFFFFF
+
+
+def _interleave_kernel(ver_ref, b_ref, e_ref, rows_ref, vals_ref, *, W: int):
+    ub = b_ref[...]              # [blk, W]
+    ue = e_ref[...]              # [blk, W]
+    blk = ub.shape[0]
+    rows_ref[...] = jnp.stack([ub, ue], axis=1).reshape(2 * blk, W)
+    ver = ver_ref[0]
+    beg_live = ub[:, W - 1] != jnp.uint32(_SENT_WORD_P)
+    v = jnp.where(beg_live, ver, 0).astype(jnp.int32)
+    vals_ref[...] = jnp.stack([v, jnp.zeros_like(v)], axis=1).reshape(2 * blk)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_interleave(rcap: int, W: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = min(1024, rcap)
+    return pl.pallas_call(
+        functools.partial(_interleave_kernel, W=W),
+        grid=(rcap // blk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),         # ver [1]
+            pl.BlockSpec((blk, W), lambda i: (i, 0)),      # begins
+            pl.BlockSpec((blk, W), lambda i: (i, 0)),      # ends
+        ],
+        out_specs=[
+            pl.BlockSpec((2 * blk, W), lambda i: (i, 0)),
+            pl.BlockSpec((2 * blk,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((2 * rcap, W), jnp.uint32),
+            jax.ShapeDtypeStruct((2 * rcap,), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+
+def run_to_step_pallas(u_b, u_e, ver, *, impl: str):
+    """Pallas twin of device.run_to_step: (rows, vals) of the run viewed as
+    a step function.  Bit-identical to the XLA interleave."""
+    if impl not in ("tpu", "interpret"):
+        raise ValueError(f"unknown impl {impl!r}; choose tpu|interpret")
+    rcap, W = u_b.shape
+    fn = _build_interleave(rcap, W, impl == "interpret")
+    ver_arr = jnp.reshape(ver, (1,)).astype(jnp.int32)
+    rows, vals = fn(ver_arr, u_b, u_e)
+    return rows, vals
+
+
+# ---------------------------------------------------------------------------
+# compact cross-rank kernel: the ONE search the scatter/gather compact folds
+# need — ub[j] = #main rows <= rec row j (upper bound via the (words, len+1)
+# lane trick, computed by the caller).  Grid is (rec blocks, main blocks)
+# with the main axis minor: each step two-level-scans one VMEM-staged main
+# block and accumulates the partial rank, so no state-sized gather ever
+# leaves HBM row order.
+
+
+def _rank_count_kernel(q_ref, m_ref, out_ref, *, stride: int, mb: int):
+    from jax.experimental import pallas as pl
+
+    m = pl.program_id(1)
+    cnt = _block_rank(m_ref[...], q_ref[...], stride=stride, nrows=mb)
+
+    @pl.when(m == 0)
+    def _init():
+        out_ref[...] = cnt
+
+    @pl.when(m > 0)
+    def _accum():
+        out_ref[...] = out_ref[...] + cnt
+
+
+@functools.lru_cache(maxsize=64)
+def _build_rank_count(cap: int, rec_cap: int, W: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    qb = min(READ_BLOCK, rec_cap)
+    mb = min(8192, cap)
+    stride = min(SUMMARY_STRIDE, mb)
+    return pl.pallas_call(
+        functools.partial(_rank_count_kernel, stride=stride, mb=mb),
+        grid=(rec_cap // qb, cap // mb),
+        in_specs=[
+            pl.BlockSpec((qb, W), lambda q, m: (q, 0)),    # rec_plus queries
+            pl.BlockSpec((mb, W), lambda q, m: (m, 0)),    # main block
+        ],
+        out_specs=pl.BlockSpec((qb,), lambda q, m: (q,)),
+        out_shape=jax.ShapeDtypeStruct((rec_cap,), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def compact_ranks(ks, rec_ks, *, impl: str) -> jnp.ndarray:
+    """ub[j] = #ks rows lexicographically <= rec_ks[j] — the Pallas lowering
+    of device._compact_ub.  Sentinel rec rows rank garbage (their length
+    lane wraps); the compact folds mask dead rows, matching the XLA search's
+    contract exactly on live rows."""
+    if impl not in ("tpu", "interpret"):
+        raise ValueError(f"unknown impl {impl!r}; choose tpu|interpret")
+    cap, W = ks.shape
+    rec_cap = rec_ks.shape[0]
+    rec_plus = rec_ks.at[:, -1].add(1)
+    fn = _build_rank_count(cap, rec_cap, W, impl == "interpret")
+    return fn(rec_plus, ks)

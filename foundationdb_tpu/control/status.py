@@ -134,6 +134,48 @@ def _data_block(cluster, dd) -> dict[str, Any]:
     }
 
 
+# Schema of the offline phase-profile artifact (phase_timings.py --json,
+# embedded by bench.py as kernel.phase_profile).  Not part of the live
+# status document — the profile needs dispatch barriers the hot path
+# must not pay — but schema'd here next to the kernel roll-up so the two
+# kernel-cost surfaces stay reviewed together.  Optional-key convention
+# matches STATUS_SCHEMA ("?" suffix = may be absent).
+PHASE_PROFILE_SCHEMA: dict[str, Any] = {
+    "backend": str,            # jax backend the profile ran on
+    "small": bool,             # reduced shapes (bench embed) vs full probe
+    "cap": int,                # main state capacity profiled
+    "rec_cap": int,            # LSM recent capacity profiled
+    "merge_impl_default": str,  # compiled-in fold default (scatter)
+    "shapes": dict,            # {n_txn, n_read, n_write, cap}
+    "rtt_ms": (int, float),    # host<->device dispatch floor
+    "intra_iters": int,        # intra-batch fixpoint iterations observed
+    "cumulative_ms": dict,     # truncation ladder, keyed by probe.log label
+    "phases_ms": dict,         # {search, history, intra, merge_buckets, full}
+    "lsm": dict,               # {full_ms, compact_ms, batches_per_compact,
+                               #  effective_ms}
+    "merge_shootout_ms": dict,  # {level_size: {sort, gather, scatter}}
+}
+
+
+def check_phase_profile(doc: dict) -> list[str]:
+    """Validate a phase-profile dict against PHASE_PROFILE_SCHEMA; returns
+    human-readable problems (empty = conforming).  Used by the bench embed
+    test so the artifact can't silently drift from the schema."""
+    problems: list[str] = []
+    for key, typ in PHASE_PROFILE_SCHEMA.items():
+        if key not in doc:
+            problems.append(f"phase_profile missing key: {key}")
+        elif not isinstance(doc[key], typ):
+            problems.append(
+                f"phase_profile.{key}: expected {typ}, got "
+                f"{type(doc[key]).__name__}"
+            )
+    for key in doc:
+        if key not in PHASE_PROFILE_SCHEMA:
+            problems.append(f"phase_profile unknown key: {key}")
+    return problems
+
+
 def _kernel_rollup(resolvers) -> dict[str, Any]:
     """Aggregate the resolvers' conflict-backend KernelStats into one
     cluster-level view (counters sum; occupancy re-derives from the summed
@@ -163,6 +205,16 @@ def _kernel_rollup(resolvers) -> dict[str, Any]:
         k: sum(p.get("phase", {}).get(k, 0.0) for p in per)
         for k in ("sort_ms", "scan_ms", "merge_ms", "compact_ms")
     }
+    # fold impl: single value when the fleet agrees, "mixed" otherwise
+    # (an autotune sweep can leave resolvers on different impls); fold_ms
+    # sums per impl so mixed fleets stay attributable
+    impls = {p.get("merge_impl", "?") for p in per}
+    out["merge_impl"] = impls.pop() if len(impls) == 1 else "mixed"
+    fold: dict[str, float] = {}
+    for p in per:
+        for k, v in p.get("fold_ms", {}).items():
+            fold[k] = fold.get(k, 0.0) + v
+    out["fold_ms"] = dict(sorted(fold.items()))
     out["abort_rate"] = out["aborted"] / out["txns"] if out["txns"] else 0.0
     out["occupancy"] = (
         out["rows_real"] / out["rows_padded"] if out["rows_padded"] else 1.0
@@ -551,6 +603,8 @@ STATUS_SCHEMA: dict = {
         "node_count": int,
         "runs_appended": int,
         "full_merges": int,
+        "merge_impl": str,
+        "fold_ms": dict,
         "phase": dict,
         "resolve_ms_p50": (int, float),
         "resolve_ms_p99": (int, float),

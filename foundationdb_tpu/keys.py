@@ -22,6 +22,8 @@ slots in device arrays.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 # Default: 32-byte keys -> 8 data words + 1 length word.  The reference's
@@ -56,6 +58,44 @@ def encode_keys(keys: list[bytes], max_key_bytes: int = DEFAULT_MAX_KEY_BYTES) -
     return encode_concat(b"".join(keys), lens, max_key_bytes)
 
 
+class _EncodeScratch(threading.local):
+    """Grow-only staging buffers reused across encode_concat calls — the
+    resolver packs a batch every few milliseconds, and reallocating the
+    zero-padded stream copy plus the per-chunk gather temporaries was a
+    measurable slice of encode_ms (the PackArena treatment, applied to the
+    encoder's own scratch).  Thread-local so pipelined feeder threads never
+    share a buffer."""
+
+    def __init__(self) -> None:
+        self.flatp = np.zeros(0, dtype=np.uint8)
+        self.idx: np.ndarray | None = None
+        self.buf: np.ndarray | None = None
+        self.mask: np.ndarray | None = None
+
+    def stream(self, flat: np.ndarray, L: int, pad: int) -> np.ndarray:
+        need = L + pad
+        if self.flatp.size < need:
+            self.flatp = np.zeros(max(need, 2 * self.flatp.size), np.uint8)
+        self.flatp[:L] = flat
+        self.flatp[L:need] = 0  # pad region may hold a previous stream
+        return self.flatp
+
+    def chunk(self, rows: int, width: int, idt) -> tuple:
+        if (
+            self.idx is None
+            or self.idx.dtype != idt
+            or self.idx.shape[0] < rows
+            or self.idx.shape[1] != width
+        ):
+            self.idx = np.empty((rows, width), dtype=idt)
+            self.buf = np.empty((rows, width), dtype=np.uint8)
+            self.mask = np.empty((rows, width), dtype=bool)
+        return self.idx[:rows], self.buf[:rows], self.mask[:rows]
+
+
+_scratch = _EncodeScratch()
+
+
 def encode_concat(
     flat: bytes | bytearray | memoryview | np.ndarray,
     lens: np.ndarray,
@@ -88,8 +128,7 @@ def encode_concat(
     # the big-endian word packing done by a single dtype view + byteswap
     # astype rather than four strided slice copies.
     L = len(flat)
-    flatp = np.zeros(L + max_key_bytes, dtype=np.uint8)
-    flatp[:L] = flat
+    flatp = _scratch.stream(flat, L, max_key_bytes)
     # gather indices reach L + max_key_bytes - 1 (the zero pad), so the
     # int32 fast path needs headroom for the pad region too
     idt = np.int32 if L + max_key_bytes < 2**31 else np.int64
@@ -104,13 +143,17 @@ def encode_concat(
     # col < L + max_key_bytes — reads past a key's end land in the next
     # key's bytes or the zero pad, and the mask multiply zeroes them.
     step = 8192
+    idx, buf, mask = _scratch.chunk(min(step, n), max_key_bytes, idt)
     for i in range(0, n, step):
         j = min(i + step, n)
-        idx = starts[i:j, None] + cols[None, :]
-        buf = flatp[idx]
-        mask = cols[None, :] < lens_t[i:j, None]
-        np.multiply(buf, mask, out=buf, casting="unsafe")
-        out[i:j, :kw] = buf.view(">u4").astype(np.uint32)
+        c = j - i
+        np.add(starts[i:j, None], cols[None, :], out=idx[:c])
+        np.take(flatp, idx[:c], out=buf[:c])
+        np.less(cols[None, :], lens_t[i:j, None], out=mask[:c])
+        np.multiply(buf[:c], mask[:c], out=buf[:c], casting="unsafe")
+        # big-endian word view assigns straight into out (numpy byteswaps
+        # on the cast copy — no astype temporary)
+        out[i:j, :kw] = buf[:c].view(">u4")
     return out
 
 

@@ -127,12 +127,15 @@ class UnseededRandomRule(Rule):
 
 # Modules allowed to touch threads: the device watchdog (bounded host-wall
 # timeouts around PJRT calls), the input-pipeline packer (never runs under
-# sim), the native build lock, and the soak campaign driver.  Everything
-# else must stay on the single-threaded run loop.
+# sim), the key encoder's thread-local scratch buffers (the packer calls
+# encode_concat from its feeder thread, so the reuse pool must not be
+# shared across threads), the native build lock, and the soak campaign
+# driver.  Everything else must stay on the single-threaded run loop.
 THREADING_ALLOWLIST = frozenset({
     "foundationdb_tpu/conflict/supervisor.py",
     "foundationdb_tpu/conflict/pipeline.py",
     "foundationdb_tpu/conflict/native.py",
+    "foundationdb_tpu/keys.py",
     "foundationdb_tpu/tools/soak.py",
 })
 

@@ -170,7 +170,7 @@ def _sharded_resolve_inc(
     slot, rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
     ok_in,
     *, cap, run_cap, n_txn, n_read, n_write, search_iters, search_impl,
-    probe_impl,
+    probe_impl, merge_impl,
 ):
     """Incremental twin of _sharded_resolve: the same clip → kernel → pmin
     shape, with the committed writes appending as a per-partition run
@@ -187,6 +187,7 @@ def _sharded_resolve_inc(
         cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
         n_write=n_write, search_iters=search_iters,
         search_impl=search_impl, probe_impl=probe_impl,
+        merge_impl=merge_impl,
     )
     merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
     all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
@@ -201,7 +202,7 @@ def _sharded_resolve_inc_lsm(
     slot, rb, re_, r_tx, wb, we, w_tx, snap, active, commit_off,
     ok_in,
     *, cap, run_cap, n_txn, n_read, n_write, search_iters, search_impl,
-    probe_impl,
+    probe_impl, merge_impl,
 ):
     """LSM twin: main history from the cached per-partition sparse table."""
     ks, tab, bidx = ks[0], tab[0], bidx[0]
@@ -215,6 +216,7 @@ def _sharded_resolve_inc_lsm(
         cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
         n_write=n_write, search_iters=search_iters,
         search_impl=search_impl, probe_impl=probe_impl,
+        merge_impl=merge_impl,
     )
     merged = jax.lax.pmin(verdict, RESOLVER_AXIS)
     all_conv = jax.lax.pmin(conv.astype(jnp.int32), RESOLVER_AXIS) > 0
@@ -225,16 +227,18 @@ def _sharded_resolve_inc_lsm(
 def build_sharded_resolver_inc(
     mesh: Mesh, *, cap: int, run_cap: int, n_txn: int, n_read: int,
     n_write: int, search_iters: int, search_impl: str, probe_impl: str,
-    lsm: bool,
+    lsm: bool, merge_impl: str | None = None,
 ):
     shard = P(RESOLVER_AXIS)
     repl = P()
+    merge_impl = impl_from_env("merge", merge_impl)
     fn = jax.shard_map(
         functools.partial(
             _sharded_resolve_inc_lsm if lsm else _sharded_resolve_inc,
             cap=cap, run_cap=run_cap, n_txn=n_txn, n_read=n_read,
             n_write=n_write, search_iters=search_iters,
             search_impl=search_impl, probe_impl=probe_impl,
+            merge_impl=merge_impl,
         ),
         mesh=mesh,
         in_specs=(shard,) * 7 + (shard, shard) + (repl,) * 11,
@@ -244,7 +248,8 @@ def build_sharded_resolver_inc(
     return jax.jit(fn)
 
 
-def _sharded_compact_runs(ks, vs, runs_b, runs_e, runs_ver, *, cap, slots):
+def _sharded_compact_runs(ks, vs, runs_b, runs_e, runs_ver, *, cap, slots,
+                          merge_impl):
     """Fold ALL run slots into each partition's main level (empty slots are
     sentinel runs at version 0 — a no-op fold), returning the per-partition
     fold-count maximum so the host can detect overflow and regrow.  One
@@ -253,15 +258,20 @@ def _sharded_compact_runs(ks, vs, runs_b, runs_e, runs_ver, *, cap, slots):
     maxcnt = jnp.int32(0)
     for s in range(slots):
         rows, vals = run_to_step(runs_b[0, s], runs_e[0, s], runs_ver[0, s])
-        k, v, cnt, bidx, tab = compact_lsm(k, v, rows, vals, cap=cap)
+        k, v, cnt, bidx, tab = compact_lsm(
+            k, v, rows, vals, cap=cap, merge_impl=merge_impl
+        )
         maxcnt = jnp.maximum(maxcnt, cnt)
     return k[None], v[None], cnt[None], bidx[None], tab[None], maxcnt[None]
 
 
-def build_sharded_run_compactor(mesh: Mesh, *, cap: int, slots: int):
+def build_sharded_run_compactor(mesh: Mesh, *, cap: int, slots: int,
+                                merge_impl: str | None = None):
     shard = P(RESOLVER_AXIS)
+    merge_impl = impl_from_env("merge", merge_impl)
     fn = jax.shard_map(
-        functools.partial(_sharded_compact_runs, cap=cap, slots=slots),
+        functools.partial(_sharded_compact_runs, cap=cap, slots=slots,
+                          merge_impl=merge_impl),
         mesh=mesh,
         in_specs=(shard,) * 5,
         out_specs=(shard,) * 6,
@@ -270,11 +280,11 @@ def build_sharded_run_compactor(mesh: Mesh, *, cap: int, slots: int):
     return jax.jit(fn)
 
 
-def _sharded_compact(ks, vs, rks, rvs, *, cap):
+def _sharded_compact(ks, vs, rks, rvs, *, cap, merge_impl):
     """Per-partition compact_lsm under shard_map (every partition folds its
     recent level at once — the host triggers when any is near full)."""
     nks, nvs, ncnt, nbidx, ntab = compact_lsm(
-        ks[0], vs[0], rks[0], rvs[0], cap=cap
+        ks[0], vs[0], rks[0], rvs[0], cap=cap, merge_impl=merge_impl
     )
     return nks[None], nvs[None], ncnt[None], nbidx[None], ntab[None]
 
@@ -301,10 +311,12 @@ def build_sharded_resolver_lsm(
     return jax.jit(fn)
 
 
-def build_sharded_compactor(mesh: Mesh, *, cap: int):
+def build_sharded_compactor(mesh: Mesh, *, cap: int,
+                            merge_impl: str | None = None):
     shard = P(RESOLVER_AXIS)
+    merge_impl = impl_from_env("merge", merge_impl)
     fn = jax.shard_map(
-        functools.partial(_sharded_compact, cap=cap),
+        functools.partial(_sharded_compact, cap=cap, merge_impl=merge_impl),
         mesh=mesh,
         in_specs=(shard,) * 4,
         out_specs=(shard,) * 5,
@@ -435,6 +447,7 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         self.search_fallbacks = 0
         self.regrows = 0
         self.stats = KernelStats(backend="sharded-device")
+        self.stats.merge_impl = self._merge_impl
         self._pipeline_init()  # staging arenas + deferred-resolve window
 
         bounds = [b""] + list(split_keys)
@@ -552,11 +565,12 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
     def _compact(self) -> None:
         """Fold every partition's recent level into its main level; regrow
         main if any partition's union no longer fits."""
+        t0 = time.perf_counter()
         while True:
-            key = ("compact", self._cap, self._rec_cap)
+            key = ("compact", self._cap, self._rec_cap, self._merge_impl)
             if key not in self._fns:
                 self._fns[key] = build_sharded_compactor(
-                    self._mesh, cap=self._cap
+                    self._mesh, cap=self._cap, merge_impl=self._merge_impl
                 )
             nks, nvs, ncnt, nbidx, ntab = self._fns[key](
                 self._ks, self._vs, self._rec_ks, self._rec_vs
@@ -575,6 +589,11 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         self._dev_counts = ncnt
         self._init_recent()
         self.compactions += 1
+        dt = time.perf_counter() - t0
+        self.stats.merge_s += dt
+        self.stats.fold_wall_s[self._merge_impl] = (
+            self.stats.fold_wall_s.get(self._merge_impl, 0.0) + dt
+        )
 
     def _grow_main(self, new_cap: int) -> None:
         """Pad main to new_cap (compaction retry).  The caller's compactor
@@ -640,6 +659,7 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         key = (
             "inc", self._lsm, self._cap, self._run_cap, n_txn, n_read,
             n_write, search_iters, self._search_impl, self._probe_impl,
+            self._merge_impl,
         )
         if key not in self._fns:
             self._fns[key] = build_sharded_resolver_inc(
@@ -647,6 +667,7 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
                 n_txn=n_txn, n_read=n_read, n_write=n_write,
                 search_iters=search_iters, search_impl=self._search_impl,
                 probe_impl=self._probe_impl, lsm=self._lsm,
+                merge_impl=self._merge_impl,
             )
         return self._fns[key]
 
@@ -846,11 +867,14 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         regrowing main when any partition's union outgrows it."""
         if self._n_runs == 0:
             return
+        t0 = time.perf_counter()
         while True:
-            key = ("compact_runs", self._cap, self._run_cap, self._K)
+            key = ("compact_runs", self._cap, self._run_cap, self._K,
+                   self._merge_impl)
             if key not in self._fns:
                 self._fns[key] = build_sharded_run_compactor(
-                    self._mesh, cap=self._cap, slots=self._K
+                    self._mesh, cap=self._cap, slots=self._K,
+                    merge_impl=self._merge_impl,
                 )
             nks, nvs, ncnt, nbidx, ntab, maxcnt = self._fns[key](
                 self._ks, self._vs, self._runs_b, self._runs_e, self._runs_ver
@@ -872,6 +896,12 @@ class ShardedDeviceConflictSet(PipelinedConflictMixin, ConflictSet):
         self._dev_counts = ncnt
         self._init_runs(self._run_cap)
         self.compactions += 1
+        dt = time.perf_counter() - t0
+        self.stats.compact_s += dt
+        self.stats.merge_s += dt
+        self.stats.fold_wall_s[self._merge_impl] = (
+            self.stats.fold_wall_s.get(self._merge_impl, 0.0) + dt
+        )
 
     def _resolve_arrays_lsm(
         self, commit_version, rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p,

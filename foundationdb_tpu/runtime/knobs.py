@@ -26,7 +26,9 @@ ENV_KNOBS: dict[str, str] = {
                           "layout with =0 (conflict/device.py)",
     "FDBTPU_LSM": "recent-window LSM layout override for the device "
                   "backend (conflict/device.py)",
-    "FDBTPU_MERGE_IMPL": "device merge implementation override "
+    "FDBTPU_MERGE_IMPL": "device merge/fold implementation override: "
+                         "scatter (default) / sort / gather — selects the "
+                         "boundary-merge, run-fold and compaction kernels "
                          "(conflict/device.py)",
     "FDBTPU_SEARCH_IMPL": "device search implementation override "
                           "(conflict/device.py)",

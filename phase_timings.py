@@ -7,11 +7,18 @@ full) at bench.py shapes on a prefilled state; each truncation returns one
 scalar digest so tunnel transfer cost never pollutes the timing (the axon
 tunnel moves whole arrays at ~45 MB/s; block_until_ready does not block).
 Phase cost = difference between successive truncations.
+
+`collect()` returns the whole report as a dict; `--json [PATH]` emits it as
+a machine-readable artifact (schema: control/status.py PHASE_PROFILE_SCHEMA).
+bench.py embeds the same dict as `kernel.phase_profile` in BENCH output, so
+phase regressions are artifact-visible instead of probe.log-only.
 """
 
 from __future__ import annotations
 
 import functools
+import json
+import sys
 import time
 
 import numpy as np
@@ -19,23 +26,40 @@ import numpy as np
 import bench as B
 
 
-def main() -> None:
+def collect(*, small: bool = False) -> dict:
+    """Measure every phase and return the report dict.
+
+    small=True shrinks state capacities and repetitions for the embedded
+    bench.py --cpu-phase run (budgeted by BENCH_CPU_PHASE_TIMEOUT); the
+    full-size run is the probe.log / BENCH_r* artifact."""
     B._enable_compile_cache()  # the ~20 truncation compiles persist for reuse
     import jax
     import jax.numpy as jnp
 
     from foundationdb_tpu.conflict import device as D
 
-    print(f"backend: {jax.default_backend()}", flush=True)
+    cap = (1 << 15) if small else B.CAP
+    rec_cap = (1 << 12) if small else B.REC_CAP
+    prefill_n = 4 if small else B.PREFILL_BATCHES
+    reps = 3 if small else 5
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "small": small,
+        "cap": cap,
+        "rec_cap": rec_cap,
+        "merge_impl_default": D._IMPL_DEFAULTS["merge"],
+    }
+    print(f"backend: {out['backend']}", flush=True)
 
     rng = np.random.default_rng(B.SEED)
     pool = B.gen_pool(rng)
     pool_words = B.pool_to_words(pool)
     versions = iter(range(1, 10_000))
-    prefill = [B.gen_batch(rng, pool, next(versions)) for _ in range(B.PREFILL_BATCHES)]
+    prefill = [B.gen_batch(rng, pool, next(versions)) for _ in range(prefill_n)]
     timed = [B.gen_batch(rng, pool, next(versions)) for _ in range(4)]
 
-    dev = D.DeviceConflictSet(max_key_bytes=B.MAX_KEY_BYTES, capacity=B.CAP)
+    dev = D.DeviceConflictSet(max_key_bytes=B.MAX_KEY_BYTES, capacity=cap)
     t0 = time.perf_counter()
     for b in prefill:
         dev.resolve_arrays(b["version"], *B.device_pack(pool_words, b, B._bucket))
@@ -49,6 +73,7 @@ def main() -> None:
     Bp, R, Wn = snap_p.shape[0], rbv.shape[0], wbv.shape[0]
     commit_off = jnp.int32(dev._offset(timed[0]["version"]))
     cap = dev._cap
+    out["shapes"] = {"n_txn": Bp, "n_read": R, "n_write": Wn, "cap": cap}
 
     def common(ks, vs, bidx, count):
         r_ok = rtv >= 0
@@ -114,6 +139,7 @@ def main() -> None:
         fetch(g(jnp.ones((8,), jnp.int32)))
         ts.append(time.perf_counter() - t0)
     rtt = sorted(ts)[2] * 1e3
+    out["rtt_ms"] = rtt
     print(f"RTT floor {rtt:.1f} ms", flush=True)
 
     results = {}
@@ -121,19 +147,28 @@ def main() -> None:
                      ("search+hist+intra", t_intra), ("FULL kernel", t_full)):
         fetch(fn(*st))  # compile
         ts = []
-        for _ in range(5):
+        for _ in range(reps):
             t0 = time.perf_counter()
-            out = fn(*st)
-            fetch(out)
+            o = fn(*st)
+            fetch(o)
             ts.append(time.perf_counter() - t0)
-        ms = sorted(ts)[2] * 1e3 - rtt
+        ms = sorted(ts)[len(ts) // 2] * 1e3 - rtt
         results[name] = ms
         extra = ""
         if name == "search+hist+intra":
-            extra = f"  (fixpoint iters: {int(np.asarray(out[1]))})"
+            out["intra_iters"] = int(np.asarray(o[1]))
+            extra = f"  (fixpoint iters: {out['intra_iters']})"
         print(f"  {name:<22s} {ms:9.1f} ms{extra}", flush=True)
 
     s = results
+    out["cumulative_ms"] = {k: round(v, 2) for k, v in results.items()}
+    out["phases_ms"] = {
+        "search": round(s["search"], 2),
+        "history": round(s["search+hist"] - s["search"], 2),
+        "intra": round(s["search+hist+intra"] - s["search+hist"], 2),
+        "merge_buckets": round(s["FULL kernel"] - s["search+hist+intra"], 2),
+        "full": round(s["FULL kernel"], 2),
+    }
     print("\nphase deltas:", flush=True)
     print(f"  search          {s['search']:9.1f} ms")
     print(f"  history (RMQ)   {s['search+hist'] - s['search']:9.1f} ms")
@@ -142,8 +177,8 @@ def main() -> None:
 
     # ---- LSM path: full kernel + amortized compaction --------------------
     ldev = D.DeviceConflictSet(
-        max_key_bytes=B.MAX_KEY_BYTES, capacity=B.CAP, lsm=True,
-        recent_capacity=B.REC_CAP,
+        max_key_bytes=B.MAX_KEY_BYTES, capacity=cap, lsm=True,
+        recent_capacity=rec_cap,
     )
     t0 = time.perf_counter()
     for b in prefill:
@@ -163,7 +198,7 @@ def main() -> None:
         verdict, nrk, nrv, nrb, nrc, conv, ok = lfull(
             ks, vs, tab, bidx, count, rks, rvs, rbidx, rcnt,
             rbv, rev, rtv, wbv, wev, wtv, snap_p, active_p, commit_off,
-            cap=B.CAP, rec_cap=ldev._rec_cap, n_txn=Bp, n_read=R, n_write=Wn,
+            cap=cap, rec_cap=ldev._rec_cap, n_txn=Bp, n_read=R, n_write=Wn,
         )
         return verdict.sum() + nrc
 
@@ -171,18 +206,20 @@ def main() -> None:
            ldev._rec_ks, ldev._rec_vs, ldev._rec_bidx, ldev._rec_dev_count)
     fetch(t_lsm(*lst))
     ts = []
-    for _ in range(5):
+    for _ in range(reps):
         t0 = time.perf_counter()
         fetch(t_lsm(*lst))
         ts.append(time.perf_counter() - t0)
-    lsm_ms = sorted(ts)[2] * 1e3 - rtt
+    lsm_ms = sorted(ts)[len(ts) // 2] * 1e3 - rtt
     print(f"  LSM FULL (no compact)  {lsm_ms:9.1f} ms", flush=True)
 
-    comp = functools.partial(jax.jit, static_argnames=("cap",))(D.compact_lsm)
+    comp = functools.partial(
+        jax.jit, static_argnames=("cap", "merge_impl", "lowering")
+    )(D.compact_lsm)
 
     @jax.jit
     def t_comp(ks, vs, rks, rvs):
-        nks, nvs, nc, nb, nt = comp(ks, vs, rks, rvs, cap=B.CAP)
+        nks, nvs, nc, nb, nt = comp(ks, vs, rks, rvs, cap=cap)
         return nc + nb[0] + nt[0, 0]
 
     cst = (ldev._ks, ldev._vs, ldev._rec_ks, ldev._rec_vs)
@@ -193,23 +230,30 @@ def main() -> None:
         fetch(t_comp(*cst))
         ts.append(time.perf_counter() - t0)
     comp_ms = sorted(ts)[1] * 1e3 - rtt
-    batches_per_compact = max((B.REC_CAP - 1) // (2 * Wn), 1)
+    batches_per_compact = max((rec_cap - 1) // (2 * Wn), 1)
     print(f"  LSM compaction         {comp_ms:9.1f} ms "
           f"(/{batches_per_compact} batches = "
           f"{comp_ms / batches_per_compact:.1f} ms amortized)", flush=True)
     print(f"  LSM effective/batch    {lsm_ms + comp_ms / batches_per_compact:9.1f} ms",
           flush=True)
+    out["lsm"] = {
+        "full_ms": round(lsm_ms, 2),
+        "compact_ms": round(comp_ms, 2),
+        "batches_per_compact": batches_per_compact,
+        "effective_ms": round(lsm_ms + comp_ms / batches_per_compact, 2),
+    }
 
     # ---- merge-impl shootout (the dominant phase, isolated) --------------
     # sort vs gather vs scatter at the RECENT-level shape (the per-batch
     # cost in LSM mode) and at full CAP (the non-LSM per-batch cost)
     print("\nmerge-impl shootout:", flush=True)
+    out["merge_shootout_ms"] = {}
     r_ok = rtv >= 0
     w_ok = (wtv >= 0) & ~D._is_sentinel(wbv)
     for label, cap_m, ks_m, vs_m, cnt_m in (
-        (f"recent 2^{B.REC_CAP.bit_length() - 1}", ldev._rec_cap,
+        (f"recent 2^{rec_cap.bit_length() - 1}", ldev._rec_cap,
          ldev._rec_ks, ldev._rec_vs, ldev._rec_dev_count),
-        (f"main   2^{B.CAP.bit_length() - 1}", dev._cap,
+        (f"main   2^{cap.bit_length() - 1}", dev._cap,
          dev._ks, dev._vs, dev._dev_count),
     ):
         # ranks from the sort search (exact at any depth)
@@ -221,6 +265,7 @@ def main() -> None:
             return wbr, wer
 
         wbr, wer = ranks_of(ks_m, cnt_m)
+        out["merge_shootout_ms"][label.replace(" ", "")] = {}
         for impl in ("sort", "gather", "scatter"):
             fn = D._MERGE_IMPLS[impl]
             jfn = functools.partial(jax.jit, static_argnames=("cap",))(fn)
@@ -236,14 +281,34 @@ def main() -> None:
             try:
                 fetch(pj(ks_m, vs_m, wbr, wer))  # compile
                 ts = []
-                for _ in range(5):
+                for _ in range(reps):
                     t0 = time.perf_counter()
                     fetch(pj(ks_m, vs_m, wbr, wer))
                     ts.append(time.perf_counter() - t0)
-                ms = sorted(ts)[2] * 1e3 - rtt
+                ms = sorted(ts)[len(ts) // 2] * 1e3 - rtt
+                out["merge_shootout_ms"][label.replace(" ", "")][impl] = round(ms, 2)
                 print(f"  {label} merge={impl:<8s} {ms:9.1f} ms", flush=True)
             except Exception as e:  # noqa: BLE001 — report and keep going
                 print(f"  {label} merge={impl:<8s} FAILED: {e!r}", flush=True)
+                out["merge_shootout_ms"][label.replace(" ", "")][impl] = None
+    return out
+
+
+def main() -> None:
+    json_path = None
+    small = "--small" in sys.argv
+    if "--json" in sys.argv:
+        i = sys.argv.index("--json")
+        json_path = sys.argv[i + 1] if i + 1 < len(sys.argv) else "-"
+    report = collect(small=small)
+    if json_path is not None:
+        payload = json.dumps(report, sort_keys=True)
+        if json_path == "-":
+            print(f"PHASE_PROFILE {payload}", flush=True)
+        else:
+            with open(json_path, "w") as f:
+                f.write(payload + "\n")
+            print(f"phase profile written to {json_path}", flush=True)
 
 
 if __name__ == "__main__":

@@ -100,6 +100,40 @@ def test_status_document_matches_schema():
     c.stop()
 
 
+def test_phase_profile_schema_check():
+    """PHASE_PROFILE_SCHEMA guards the bench-embedded phase_timings
+    artifact: a conforming doc passes, and missing/unknown/mistyped keys
+    are each reported (the artifact cannot silently drift)."""
+    from foundationdb_tpu.control.status import (
+        PHASE_PROFILE_SCHEMA,
+        check_phase_profile,
+    )
+
+    doc = {
+        "backend": "cpu", "small": True, "cap": 1 << 15, "rec_cap": 1 << 12,
+        "merge_impl_default": "scatter",
+        "shapes": {"n_txn": 8, "n_read": 16, "n_write": 16, "cap": 1 << 15},
+        "rtt_ms": 0.1, "intra_iters": 2,
+        "cumulative_ms": {"search": 1.0, "FULL kernel": 4.0},
+        "phases_ms": {"search": 1.0, "history": 1.0, "intra": 1.0,
+                      "merge_buckets": 1.0, "full": 4.0},
+        "lsm": {"full_ms": 2.0, "compact_ms": 1.0, "batches_per_compact": 4,
+                "effective_ms": 2.25},
+        "merge_shootout_ms": {"main2^15": {"sort": 3.0, "gather": 2.0,
+                                           "scatter": 1.0}},
+    }
+    assert set(doc) == set(PHASE_PROFILE_SCHEMA)
+    assert check_phase_profile(doc) == []
+    bad = dict(doc)
+    del bad["phases_ms"]
+    bad["surprise"] = 1
+    bad["cap"] = "not-an-int"
+    problems = check_phase_profile(bad)
+    assert any("missing key: phases_ms" in p for p in problems)
+    assert any("unknown key: surprise" in p for p in problems)
+    assert any("phase_profile.cap" in p for p in problems)
+
+
 def test_profiler_accumulates_busy_time():
     c = RecoverableCluster(seed=604, n_storage_shards=1, storage_replication=2)
     c.loop.profile = True

@@ -258,6 +258,247 @@ def test_sharded_interpret_probe():
         assert cs.resolve_batch(version, txns) == ref.resolve_batch(version, txns)
 
 
+@pytest.mark.parametrize("lsm", [False, True], ids=["flat", "lsm"])
+def test_merge_impl_parity_sweep(lsm):
+    """sort / gather / scatter merge impls are parity REFEREES for each
+    other: the same adversarial stream (tiny alphabet → heavy duplicate
+    boundary keys, occasional write-free batches → empty runs) must produce
+    identical verdicts AND a bit-identical boundary state through repeated
+    deferred folds."""
+    import numpy as np
+
+    streams, states = {}, {}
+    for impl in ("sort", "scatter", "gather"):
+        rng = random.Random(42)
+        dev = DeviceConflictSet(
+            capacity=1 << 9, lsm=lsm, incremental=True,
+            run_slots=2, run_capacity=64, merge_impl=impl,
+        )
+        out = []
+        version = 0
+        for i in range(16):
+            version += rng.randrange(1, 6)
+            txns = [
+                TxInfo(
+                    read_snapshot=max(version - rng.randrange(1, 4), 0),
+                    read_ranges=[_rand_range(rng) for _ in range(rng.randrange(3))],
+                    # every 4th batch writes nothing: the run append must
+                    # fold empty interval sets identically under all impls
+                    write_ranges=(
+                        [] if i % 4 == 3
+                        else [(k := _rand_key(rng, b"ab", 2), k + b"\x00")
+                              for _ in range(rng.randrange(1, 4))]
+                    ),
+                )
+                for _ in range(rng.randrange(1, 8))
+            ]
+            out.append(dev.resolve_batch(version, txns))
+        assert dev.compactions >= 1, "deferred fold never fired — weak setup"
+        streams[impl] = out
+        states[impl] = (
+            np.asarray(dev._ks).copy(), np.asarray(dev._vs).copy(),
+            dev.boundary_count,
+        )
+        assert dev.kernel_stats()["merge_impl"] == impl
+        assert impl in dev.kernel_stats()["fold_ms"]
+    for impl in ("scatter", "gather"):
+        assert streams[impl] == streams["sort"], impl
+        assert np.array_equal(states[impl][0], states["sort"][0]), impl
+        assert np.array_equal(states[impl][1], states["sort"][1]), impl
+        assert states[impl][2] == states["sort"][2], impl
+
+
+def test_compact_fold_parity_adversarial():
+    """Direct compact_lsm fold parity across all three impls and the Pallas
+    interpret lowering of the rank search, on adversarial inputs: recent
+    rows duplicating main boundary keys exactly, and an empty recent level."""
+    import numpy as np
+
+    jnp = pytest.importorskip("jax.numpy")
+    from foundationdb_tpu import keys as keymod
+    from foundationdb_tpu.conflict import device as D
+
+    rng = np.random.default_rng(7)
+    W = keymod.num_words(16)
+    SENT = np.uint32(0xFFFFFFFF)
+    cap, rec_cap = 256, 64
+
+    def sorted_rows(raws):
+        rows = keymod.encode_keys(raws, 16)
+        order = np.lexsort(tuple(rows[:, w] for w in range(W - 1, -1, -1)))
+        return rows[order]
+
+    for trial in range(4):
+        n_live = int(rng.integers(2, 120))
+        pool = sorted({int(x).to_bytes(4, "big")
+                       for x in rng.integers(0, 1 << 30, n_live * 2)})
+        rows = sorted_rows([b""] + pool[: n_live - 1])
+        ks = np.full((cap, W), SENT, dtype=np.uint32)
+        ks[: rows.shape[0]] = rows
+        vs = np.zeros(cap, np.int32)
+        vs[: rows.shape[0]] = np.sort(
+            rng.integers(0, 1000, rows.shape[0]).astype(np.int32))
+        if trial == 0:
+            n_rec = 0          # empty recent: the fold must be an identity
+        elif trial == 1:
+            # adversarial: recent duplicates main boundary keys exactly
+            rec_rows = np.asarray(ks)[1: 1 + min(8, rows.shape[0] - 1)]
+            n_rec = rec_rows.shape[0]
+        else:
+            n_rec = int(rng.integers(1, rec_cap // 2))
+            rpool = sorted({int(x).to_bytes(4, "big")
+                            for x in rng.integers(0, 1 << 30, n_rec * 2)})
+            rec_rows = sorted_rows(rpool[:n_rec])
+            n_rec = rec_rows.shape[0]
+        rec_ks = np.full((rec_cap, W), SENT, dtype=np.uint32)
+        rec_vs = np.zeros(rec_cap, np.int32)
+        if n_rec:
+            rec_ks[:n_rec] = rec_rows
+            rec_vs[:n_rec] = rng.integers(0, 1000, n_rec).astype(np.int32)
+        args = (jnp.asarray(ks), jnp.asarray(vs),
+                jnp.asarray(rec_ks), jnp.asarray(rec_vs))
+        ref = D.compact_lsm(*args, cap=cap, merge_impl="sort")
+        for impl in ("scatter", "gather"):
+            for lowering in ("xla", "interpret"):
+                got = D.compact_lsm(*args, cap=cap, merge_impl=impl,
+                                    lowering=lowering)
+                for i, name in enumerate(("ks", "vs", "count", "bidx", "tab")):
+                    assert np.array_equal(
+                        np.asarray(ref[i]), np.asarray(got[i])
+                    ), (trial, impl, lowering, name)
+
+
+def test_intra_rank_space_parity():
+    """The rank-space intra-batch fixpoint (sparse-table over local ranks)
+    must match the dense [R,Wn] referee bit-for-bit — verdict bits AND
+    iteration counts — and so must its Pallas interpret lowering."""
+    import numpy as np
+
+    jnp = pytest.importorskip("jax.numpy")
+    from foundationdb_tpu import keys as keymod
+    from foundationdb_tpu.conflict import device as D
+
+    rng = np.random.default_rng(3)
+    B, R, Wn = 32, 64, 64
+
+    def intervals(n):
+        b = rng.integers(0, 1 << 20, n)
+        e = b + rng.integers(1, 1 << 10, n)
+        rows_b = keymod.encode_keys([int(x).to_bytes(4, "big") for x in b], 16)
+        rows_e = keymod.encode_keys([int(x).to_bytes(4, "big") for x in e], 16)
+        return jnp.asarray(rows_b), jnp.asarray(rows_e)
+
+    for trial in range(4):
+        rb, re_ = intervals(R)
+        wb, we = intervals(Wn)
+        r_tx = rng.integers(-1, B, R).astype(np.int32)
+        w_tx = rng.integers(-1, B, Wn).astype(np.int32)
+        args = (
+            rb, re_, wb, we,
+            jnp.asarray(r_tx >= 0), jnp.asarray(w_tx >= 0),
+            jnp.asarray(np.clip(r_tx, 0, B - 1)),
+            jnp.asarray(np.clip(w_tx, 0, B - 1)),
+            jnp.asarray(w_tx),
+            jnp.asarray(rng.random(B) < 0.9),   # active
+            jnp.asarray(rng.random(B) < 0.2),   # prior history conflicts
+            B,
+        )
+        a_dense, n_dense = D.phase_intra_dense(*args)
+        a_rank, n_rank = D.phase_intra(*args)
+        a_pl, n_pl = D.phase_intra(*args, impl="interpret")
+        assert np.array_equal(np.asarray(a_dense), np.asarray(a_rank)), trial
+        assert int(n_dense) == int(n_rank), trial
+        assert np.array_equal(np.asarray(a_dense), np.asarray(a_pl)), trial
+        assert int(n_dense) == int(n_pl), trial
+
+
+def test_fused_probe_and_run_to_step_parity():
+    """The fused history+probe kernel equals hist OR unfused probe (the OR
+    of scatters == scatter of ORs identity), XLA vs interpret; and the
+    interleave (run_to_step) Pallas lowering is bit-identical to XLA."""
+    import numpy as np
+
+    jnp = pytest.importorskip("jax.numpy")
+    from foundationdb_tpu import keys as keymod
+    from foundationdb_tpu.conflict import device as D
+
+    rng = np.random.default_rng(11)
+    Wn, K, run_cap, R = 32, 4, 128, 64
+
+    def intervals(n):
+        b = rng.integers(0, 1 << 16, n)
+        e = b + rng.integers(1, 1 << 8, n)
+        rows_b = keymod.encode_keys([int(x).to_bytes(4, "big") for x in b], 16)
+        rows_e = keymod.encode_keys([int(x).to_bytes(4, "big") for x in e], 16)
+        return jnp.asarray(rows_b), jnp.asarray(rows_e)
+
+    wb, we = intervals(Wn)
+    w_ins = jnp.asarray(rng.random(Wn) < 0.7)
+    u_sort = D._union_intervals(wb, we, w_ins, run_cap=run_cap,
+                                merge_impl="sort")
+    u_scat = D._union_intervals(wb, we, w_ins, run_cap=run_cap,
+                                merge_impl="scatter")
+    assert np.array_equal(np.asarray(u_sort[0]), np.asarray(u_scat[0]))
+    assert np.array_equal(np.asarray(u_sort[1]), np.asarray(u_scat[1]))
+
+    u_b, u_e = u_sort
+    s_xla = D.run_to_step(u_b, u_e, jnp.int32(42))
+    s_pl = D.run_to_step(u_b, u_e, jnp.int32(42), impl="interpret")
+    assert np.array_equal(np.asarray(s_xla[0]), np.asarray(s_pl[0]))
+    assert np.array_equal(np.asarray(s_xla[1]), np.asarray(s_pl[1]))
+
+    runs_b = jnp.stack([u_b] * K)
+    runs_e = jnp.stack([u_e] * K)
+    runs_ver = jnp.asarray(rng.integers(0, 100, K).astype(np.int32))
+    rb, re_ = intervals(R)
+    snap_r = jnp.asarray(rng.integers(0, 100, R).astype(np.int32))
+    r_ok = jnp.asarray(rng.random(R) < 0.9)
+    hist_r = jnp.asarray(rng.random(R) < 0.3) & r_ok
+    fused_args = (rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver, hist_r)
+    f_xla = pallas_kernel.run_conflicts_fused(*fused_args, impl="xla")
+    f_int = pallas_kernel.run_conflicts_fused(*fused_args, impl="interpret")
+    unfused = hist_r | pallas_kernel.run_conflicts(
+        rb, re_, snap_r, r_ok, runs_b, runs_e, runs_ver, impl="xla")
+    assert np.array_equal(np.asarray(f_xla), np.asarray(unfused))
+    assert np.array_equal(np.asarray(f_xla), np.asarray(f_int))
+
+
+def test_merge_impl_sharded_parity():
+    """The sharded backend folds per-partition with the same impl family:
+    all three must agree with the multi-oracle on one duplicate-heavy
+    stream that forces at least one deferred fold."""
+    from foundationdb_tpu.parallel.sharded import (
+        ShardedDeviceConflictSet,
+        make_resolver_mesh,
+    )
+    from tests.test_sharded import MultiOracle
+
+    mesh = make_resolver_mesh(2)
+    splits = [b"b"]
+    for impl in ("sort", "scatter", "gather"):
+        rng = random.Random(17)
+        ref = MultiOracle(splits)
+        cs = ShardedDeviceConflictSet(
+            mesh, splits, capacity=1 << 8, incremental=True,
+            run_slots=2, run_capacity=32, merge_impl=impl,
+        )
+        version = 0
+        for _ in range(8):
+            version += rng.randrange(1, 4)
+            txns = [
+                TxInfo(
+                    read_snapshot=max(version - 2, 0),
+                    read_ranges=[_rand_range(rng)],
+                    write_ranges=[(k := _rand_key(rng, b"abc", 3), k + b"\x00")],
+                )
+                for _ in range(rng.randrange(1, 6))
+            ]
+            assert cs.resolve_batch(version, txns) == ref.resolve_batch(
+                version, txns), impl
+        assert cs.compactions >= 1, impl
+        assert cs.kernel_stats()["merge_impl"] == impl
+
+
 @pytest.mark.slow
 def test_pallas_compiled_tpu_parity():
     """Compiled-Pallas lowering on real TPU hardware (the production path
