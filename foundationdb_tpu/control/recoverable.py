@@ -1244,6 +1244,19 @@ class RecoverableCluster:
         self.fs.flush_buffers()
         return self.power_off()
 
+    def ready(self) -> bool:
+        """Is the cluster serving commits?  The readiness signal the
+        process supervisor observes (tools/server.py --ready-file writes
+        only once this is true): a booting or mid-recovery cluster is not
+        ready, a wedged one never becomes ready — which is how a rolling
+        bounce distinguishes "still recovering" from "needs attention"."""
+        from .controller import RecoveryState
+
+        return not getattr(self, "_stopped", False) and (
+            self.controller.recovery_state
+            in (RecoveryState.ACCEPTING_COMMITS, RecoveryState.FULLY_RECOVERED)
+        )
+
     def stop(self) -> None:
         # idempotent: a power-killed cluster (SaveAndKill) is stop()ped
         # again by run_spec's teardown; the second call must be a no-op

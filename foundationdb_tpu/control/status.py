@@ -815,6 +815,72 @@ def validate_coverage_event(ev: dict) -> None:
     validate_status(ev, CODE_COVERAGE_SCHEMA, "coverage")
 
 
+# -- process-supervisor events (tools/fdbmonitor.py) --------------------------
+#
+# The supervisor's trace plane is an operator-facing contract: the bounce
+# driver (tools/bounce.py) and soak triage parse these events, so their
+# shapes are schema-pinned like role metrics.  Extra harness-stamped
+# fields (Time/Severity/Machine/WallTime) pass through unchecked, same as
+# every other event schema here.
+
+MONITOR_EVENT_SCHEMA: dict = {
+    "MonitorStarted": {
+        "Conf": str,
+        "Pid": int,
+        "Sections": str,        # comma-joined section names
+    },
+    "MonitorStopped": {
+        "Pid": int,
+    },
+    "ProcessStarted": {
+        "Section": str,
+        "Pid": int,
+        "Cmd": str,
+    },
+    "ProcessRestarted": {
+        "Section": str,
+        "Pid": int,
+        "Restarts": int,
+    },
+    "ProcessStopped": {
+        "Section": str,
+        "Pid": int,
+        "Reason": str,          # shutdown | conf-removed | conf-changed
+    },
+    "ProcessDied": {
+        "Section": str,
+        "Pid": int,
+        "ExitCode": int,        # negative = killed by that signal number
+        "RanS": _NUM,
+        "RestartInS": _NUM,     # -1.0 = restart disabled: stays dead
+    },
+    "ProcessSpawnFailed": {
+        "Section": str,
+        "Error": str,
+        "RetryInS": _NUM,
+    },
+    "MonitorConfInvalid": {
+        "Conf": str,
+        "Error": str,
+    },
+    "ConfReloaded": {
+        "Generation": int,
+        "Added": str,           # comma-joined section names (may be empty)
+        "Removed": str,
+        "Changed": str,
+    },
+}
+
+
+def validate_monitor_event(ev: dict) -> None:
+    """Raise ValueError where a supervisor trace event violates its schema
+    (unknown supervisor event types also raise)."""
+    spec = MONITOR_EVENT_SCHEMA.get(ev.get("Type"))
+    if spec is None:
+        raise ValueError(f"unknown monitor event type {ev.get('Type')!r}")
+    validate_status(ev, spec, f"monitor.{ev['Type']}")
+
+
 def validate_metrics_event(ev: dict) -> None:
     """Raise ValueError where a `*Metrics` trace event violates its schema
     (unknown metrics event types also raise: a new role metric must be

@@ -129,14 +129,18 @@ class UnseededRandomRule(Rule):
 # timeouts around PJRT calls), the input-pipeline packer (never runs under
 # sim), the key encoder's thread-local scratch buffers (the packer calls
 # encode_concat from its feeder thread, so the reuse pool must not be
-# shared across threads), the native build lock, and the soak campaign
-# driver.  Everything else must stay on the single-threaded run loop.
+# shared across threads), the native build lock, the soak campaign driver,
+# and the rolling-bounce campaign driver (its load generator runs blocking
+# gateway clients against real OS processes from worker threads — never
+# sim-reachable).  Everything else must stay on the single-threaded run
+# loop.
 THREADING_ALLOWLIST = frozenset({
     "foundationdb_tpu/conflict/supervisor.py",
     "foundationdb_tpu/conflict/pipeline.py",
     "foundationdb_tpu/conflict/native.py",
     "foundationdb_tpu/keys.py",
     "foundationdb_tpu/tools/soak.py",
+    "foundationdb_tpu/tools/bounce.py",
 })
 
 _THREAD_MODULES = {"threading", "_thread", "concurrent.futures", "multiprocessing"}

@@ -43,7 +43,7 @@ from ..runtime.core import BrokenPromise, EventLoop, Future, TaskPriority, Timed
 from ..runtime.knobs import CoreKnobs
 from ..runtime.metrics import WireStats
 from ..runtime.serialize import (
-    PROTOCOL_VERSION,
+    announced_protocol_version,
     decode_frame,
     decode_payload,
     encode_frame,
@@ -150,6 +150,11 @@ class RealProcess(EndpointTable):
         super().__init__(address, name)
         self.net = net
         self._token_seq = 0
+        # SimProcess shape: death hooks (SimFilesystem.open registers one).
+        # A real process's death IS the OS tearing everything down, so
+        # nothing ever fires these — but holders (disk-backed coordinator
+        # registers) must be able to register them.
+        self.on_death: list = []
 
     def new_token(self) -> str:
         self._token_seq += 1
@@ -197,6 +202,17 @@ class RealNetwork:
         self.messages_dropped = 0
         self.frames_rejected = 0   # length-corrupt/oversized headers severed
         self.decode_failures = 0   # well-framed but undeserializable payloads
+        # the version stamped into this process's hello frames (normally
+        # the build's PROTOCOL_VERSION; FDBTPU_PROTOCOL_VERSION overrides
+        # it for mixed-version upgrade tests)
+        self.protocol_version = announced_protocol_version()
+        # (peer, their version) pairs already traced as mismatched: a
+        # redialing old/new pair severs on EVERY connection attempt (a
+        # rolling bounce retries for seconds), but the operator-facing
+        # trace gets exactly ONE TransportProtocolMismatch per pair — a
+        # later MATCHING hello from the peer (it upgraded) clears its
+        # entries so a genuine re-downgrade traces anew
+        self._mismatch_traced: set[tuple[str, str]] = set()
 
     def _trace_wire_error(self, event_type: str, conn: "_Conn", **fields) -> None:
         if self.trace is not None:
@@ -290,7 +306,7 @@ class RealNetwork:
         # bare decode-failure loop (FlowTransport's ConnectPacket carries
         # currentProtocolVersion for the same diagnosis)
         conn.queue_frame(
-            encode_frame("__hello__", self.address, PROTOCOL_VERSION,
+            encode_frame("__hello__", self.address, self.protocol_version,
                          stats=self.wire)
         )
         return conn
@@ -459,18 +475,32 @@ class RealNetwork:
             return
         for token, peer_addr, payload in decoded:
             if token == "__hello__":
-                if payload is not None and payload != PROTOCOL_VERSION:
+                if payload is not None and payload != self.protocol_version:
                     # mixed-version pair: sever with a NAMED reason (a
                     # pre-codec peer never even reaches here — its pickled
-                    # hello fails decode_frame above)
-                    self._trace_wire_error(
-                        "TransportProtocolMismatch", conn,
-                        Ours=hex(PROTOCOL_VERSION),
-                        Theirs=hex(payload) if isinstance(payload, int)
-                        else repr(payload)[:40],
-                    )
+                    # hello fails decode_frame above).  Deduped per
+                    # (peer, their version): during a rolling bounce the
+                    # old/new pair redials every retry interval and severs
+                    # each time, but exactly one mismatch event per pair
+                    # reaches the trace plane
+                    theirs = (hex(payload) if isinstance(payload, int)
+                              else repr(payload)[:40])
+                    key = (str(peer_addr), theirs)
+                    if key not in self._mismatch_traced:
+                        self._mismatch_traced.add(key)
+                        self._trace_wire_error(
+                            "TransportProtocolMismatch", conn,
+                            Ours=hex(self.protocol_version), Theirs=theirs,
+                            PeerAddress=str(peer_addr),
+                        )
                     self._drop_conn(conn)
                     return
+                # a matching hello proves the peer runs OUR version now:
+                # forget any mismatch we traced against its old one
+                self._mismatch_traced = {
+                    k for k in self._mismatch_traced
+                    if k[0] != str(peer_addr)
+                }
                 conn.addr = peer_addr
                 # reuse this connection for outbound traffic to the peer
                 if peer_addr not in self._conns or self._conns[peer_addr].dead:

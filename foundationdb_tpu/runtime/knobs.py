@@ -53,6 +53,13 @@ ENV_KNOBS: dict[str, str] = {
                        "when the caller names none: file://<prefix>, "
                        "blob://<name>, or http://host:port/<name> against a "
                        "BlobStoreServer (client/backup.py)",
+    "FDBTPU_PROTOCOL_VERSION": "override the protocol version this process "
+                               "announces in its transport hello (hex or "
+                               "decimal int; runtime/serialize.py) — the "
+                               "mixed-version upgrade-test hook: an "
+                               "\"old\" peer severs cleanly at the hello "
+                               "with one traced TransportProtocolMismatch "
+                               "(tools/bounce.py)",
 }
 
 
@@ -242,6 +249,22 @@ class CoreKnobs(Knobs):
         # syscall).  Soak triage (tools/soak.py) surfaces the per-seed
         # SlowTask count.
         self.init("SLOW_TASK_THRESHOLD", 0.5)
+
+        # process supervisor (tools/fdbmonitor.py; fdbmonitor.cpp restart
+        # backoff): a crashed child restarts after MONITOR_RESTART_BACKOFF
+        # seconds, doubling per death up to MONITOR_MAX_BACKOFF; a run of
+        # MONITOR_BACKOFF_RESET seconds before dying resets the delay (only
+        # a crash LOOP escalates).  The conf file is polled for changes
+        # every MONITOR_CONF_POLL seconds (SIGHUP forces it), and a stopped
+        # child gets MONITOR_KILL_GRACE seconds between SIGTERM and
+        # SIGKILL.  The conf's [general] section overrides all five
+        # (restart-delay / max-restart-delay / backoff-reset / conf-poll /
+        # kill-grace).
+        self.init("MONITOR_RESTART_BACKOFF", 0.25)
+        self.init("MONITOR_MAX_BACKOFF", 8.0)
+        self.init("MONITOR_BACKOFF_RESET", 10.0)
+        self.init("MONITOR_CONF_POLL", 0.5)
+        self.init("MONITOR_KILL_GRACE", 5.0)
 
         # commit-plane wire (docs/WIRE.md): transport write coalescing.
         # Queued frames flush once per reactor tick, or immediately once a

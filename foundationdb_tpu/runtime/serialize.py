@@ -16,6 +16,7 @@ product property here.
 
 from __future__ import annotations
 
+import os
 import pickle
 import struct
 import time as _time
@@ -38,6 +39,19 @@ from typing import Any, Callable, Iterable
 # byte-identical, but a pre-selector peer meeting a getKey frame must
 # sever once at the hello, not per message.
 PROTOCOL_VERSION = 0x0F_DB_71_03
+
+
+def announced_protocol_version() -> int:
+    """The version this process stamps into its transport hello and
+    requires of peers.  Normally the build's own PROTOCOL_VERSION; the
+    FDBTPU_PROTOCOL_VERSION env override exists so upgrade tests
+    (tools/bounce.py's mixed-version bounce) can boot a genuinely
+    "old" OS process and watch the pair sever cleanly at the hello —
+    read once at process start, like every launch-time env knob."""
+    raw = os.environ.get("FDBTPU_PROTOCOL_VERSION")
+    if not raw:
+        return PROTOCOL_VERSION
+    return int(raw, 16) if raw.lower().startswith("0x") else int(raw)
 
 
 class BinaryWriter:

@@ -51,6 +51,13 @@ WARN_EVENT_TYPES = frozenset({
     "TLogDiskError",             # roles/tlog.py: the durable log's disk
                                  # refused (ENOSPC/injected error); the
                                  # push is unacked and the proxy escalates
+    "ProcessDied",               # tools/fdbmonitor.py: a supervised OS
+                                 # process exited (Section/Pid/ExitCode);
+                                 # soak triage folds these into
+                                 # first_events per artifact dir
+    "MonitorConfInvalid",        # tools/fdbmonitor.py: torn/unparseable
+                                 # conf — the LAST GOOD conf stays live
+                                 # (never kill the world over a half-save)
 })
 
 

@@ -410,6 +410,18 @@ def main() -> None:
         from .fdbtop import main as top_main
 
         sys.exit(top_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "monitor":
+        # process supervisor daemon (tools/fdbmonitor.py; the fdbmonitor
+        # analog): `cli monitor --conf fdbmonitor.conf [--trace-file ...]`
+        from .fdbmonitor import main as monitor_main
+
+        sys.exit(monitor_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "bounce":
+        # rolling-bounce campaign driver over the supervisor on the real
+        # TCP fabric (tools/bounce.py; runbook in docs/OPERATIONS.md)
+        from .bounce import main as bounce_main
+
+        sys.exit(bounce_main(sys.argv[2:]))
     Cli().repl()
 
 

@@ -23,6 +23,8 @@ carries the state, which is the failure mode the test kills exercise.
 from __future__ import annotations
 
 import argparse
+import os
+import signal
 
 
 LEADER_TOKENS = ("wlt:leader_read", "wlt:leader_write")
@@ -37,7 +39,26 @@ def main(argv=None) -> None:
                     help="base path for rolling trace files "
                          "(<path>.<seq>.jsonl): wire errors + periodic "
                          "WireMetrics from this coordinator process")
+    ap.add_argument("--ready-file", default=None,
+                    help="path written (atomically) once the registers are "
+                         "listening — the supervisor's readiness probe; "
+                         "removed on shutdown")
+    ap.add_argument("--store-dir", default=None,
+                    help="durable register store (storage/image.py format), "
+                         "saved on clean shutdown and restored at boot — "
+                         "the reference coordinator's on-disk "
+                         "localGenerationReg.  Without it a bounced "
+                         "coordinator rejoins empty, and a rolling bounce "
+                         "of the whole quorum silently erases the cluster "
+                         "state")
     args = ap.parse_args(argv)
+
+    # SIGTERM = the supervisor's clean-shutdown request: unwind through
+    # the same finally as Ctrl-C so the socket closes and traces flush
+    def _sigterm(_signo, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
 
     from ..control.coordination import Coordinator
     from ..rpc.transport import NetDriver, RealNetwork
@@ -59,15 +80,48 @@ def main(argv=None) -> None:
     if trace is not None:
         trace.machine = f"coord:{net.address.port}"
         spawn_wire_metrics(loop, trace, net.wire, knobs.METRICS_INTERVAL, "tcp")
-    Coordinator(net.process, loop)  # cluster-state register
-    Coordinator(net.process, loop, tokens=LEADER_TOKENS)  # leader register
+    fs = None
+    if args.store_dir:
+        from ..runtime.core import DeterministicRandom
+        from ..storage.files import SimFilesystem
+        from ..storage.image import load_image, restore_filesystem
+
+        if os.path.exists(os.path.join(args.store_dir, "manifest.json")):
+            files, _manifest = load_image(args.store_dir)
+            fs = restore_filesystem(files)
+            fs.reattach(loop, DeterministicRandom(net.address.port))
+        else:
+            fs = SimFilesystem(loop, DeterministicRandom(net.address.port))
+    # cluster-state + leader registers; disk-backed when --store-dir is set
+    Coordinator(net.process, loop, fs=fs, path="cstate.reg")
+    Coordinator(net.process, loop, fs=fs, path="leader.reg",
+                tokens=LEADER_TOKENS)
     print(f"coordinator ready on {net.address.ip}:{net.address.port}", flush=True)
+    if args.ready_file:
+        tmp = args.ready_file + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{net.address.ip}:{net.address.port}\n")
+        os.replace(tmp, args.ready_file)
     try:
         NetDriver(loop, net).serve_forever(wall_timeout=args.run_seconds)
     except KeyboardInterrupt:
         pass
     finally:
+        if args.ready_file:
+            try:
+                os.unlink(args.ready_file)
+            except OSError:
+                pass
         net.close()
+        if fs is not None and args.store_dir:
+            from ..storage.image import save_image
+
+            # clean shutdown: flush THEN image, so the saved registers are
+            # exactly what this process last acked
+            fs.flush_buffers()
+            save_image(fs, args.store_dir, {
+                "config": {"role": "coordinator", "port": net.address.port},
+            })
         if sink is not None:
             sink.close()
 

@@ -18,6 +18,8 @@ Multi-OS-process deployment rides rpc/transport.py's real TCP fabric."""
 from __future__ import annotations
 
 import argparse
+import os
+import signal
 import sys
 import time
 
@@ -63,7 +65,29 @@ def main(argv=None) -> None:
                          "published to it for client discovery")
     ap.add_argument("--run-seconds", type=float, default=None,
                     help="exit after N wall seconds (default: run forever)")
+    ap.add_argument("--ready-file", default=None,
+                    help="path written (atomically) once the cluster is "
+                         "accepting commits and the gateway port is open — "
+                         "the supervisor's readiness probe (fdbmonitor "
+                         "waits on it before counting a bounce complete); "
+                         "removed again on shutdown")
+    ap.add_argument("--image-dir", default=None,
+                    help="durable restart image directory: boot FROM it when "
+                         "it holds a complete image (refusing a config "
+                         "mismatch), and save a fresh image on clean "
+                         "shutdown (SIGTERM / --run-seconds expiry) — the "
+                         "rolling-bounce persistence seam: acked commits "
+                         "survive the process")
     args = ap.parse_args(argv)
+
+    # SIGTERM is the supervisor's clean-shutdown request (fdbmonitor's
+    # kill path): route it through the same KeyboardInterrupt unwind as
+    # Ctrl-C so trace sinks flush, sockets close and the restart image
+    # (if any) is saved before exit
+    def _sigterm(_signo, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
 
     from ..control.recoverable import RecoverableCluster
     from ..runtime.knobs import CoreKnobs
@@ -119,6 +143,27 @@ def main(argv=None) -> None:
             external_cstate=cstate,
             wall_driver=NetDriver(loop, rnet),
         )
+    # the restart manifest doubles as the config check: a bounce that
+    # changes the cluster shape must not silently reinterpret old disks
+    config = dict(
+        seed=args.seed, shards=args.shards, replication=args.replication,
+        engine=args.engine, workers=args.workers,
+    )
+    if args.image_dir and os.path.exists(
+        os.path.join(args.image_dir, "manifest.json")
+    ):
+        from ..storage.image import load_image, restore_filesystem
+
+        files, manifest = load_image(args.image_dir)
+        for k, v in config.items():
+            if manifest.get("config", {}).get(k) != v:
+                raise SystemExit(
+                    f"restart image {args.image_dir} was saved with "
+                    f"{k}={manifest.get('config', {}).get(k)!r}, "
+                    f"this process wants {v!r} — refusing to boot"
+                )
+        extra["fs"] = restore_filesystem(files)
+        extra["restart"] = True
     cluster = RecoverableCluster(
         seed=args.seed,
         n_storage_shards=args.shards,
@@ -201,12 +246,32 @@ def main(argv=None) -> None:
         driver.run_until(cluster.loop.spawn(publish_once()), wall_timeout=30.0)
         cluster.loop.spawn(reassert())
     print(f"fdbtpu server ready on 127.0.0.1:{gw.port}", flush=True)
+    if args.ready_file and cluster.ready():
+        # atomic: a supervisor polling the path never reads a torn file,
+        # and the payload is the discovery hint (the gateway address)
+        tmp = args.ready_file + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"127.0.0.1:{gw.port}\n")
+        os.replace(tmp, args.ready_file)
     try:
         driver.serve_forever(wall_timeout=args.run_seconds)
     except KeyboardInterrupt:
         pass
     finally:
+        if args.ready_file:
+            try:
+                os.unlink(args.ready_file)
+            except OSError:
+                pass
         gw.close()
+        if args.image_dir:
+            # clean shutdown = flush everything durable, power off, save
+            # the restart image the NEXT process lifetime boots from —
+            # this is what makes a SIGTERM bounce lose zero acked commits
+            from ..storage.image import save_image
+
+            fs = cluster.clean_shutdown()
+            save_image(fs, args.image_dir, {"config": config})
         cluster.stop()
         if rnet is not None:
             rnet.close()

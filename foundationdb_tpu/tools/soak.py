@@ -272,9 +272,36 @@ def triage_seed(events: list[dict[str, Any]], spec_path: str,
         ),
         "blob_retry_count": blob_retry_count(events),
         "hottest_shards": hottest_shards(events),
+        "process_deaths": process_deaths(events),
         "slowest_transaction": slow[0] if slow else None,
         "repro": repro_command(spec_path, seed),
     }
+
+
+def process_deaths(events: list[dict[str, Any]]) -> list[dict]:
+    """Supervisor-attributed process deaths (tools/fdbmonitor.py
+    `ProcessDied` events, folded in when a run's artifact dir includes the
+    supervisor's own trace files): which conf SECTION died how many times
+    and how it last exited — a crash loop or a restart-disabled section
+    reads straight off this table.  The raw events also land in
+    first_events (they are SEV_WARN), so the per-death timeline keeps its
+    wall-order position among the cluster's other warnings."""
+    by_section: dict = {}
+    for e in events:
+        if e.get("Type") != "ProcessDied":
+            continue
+        sec = e.get("Section") or "?"
+        row = by_section.setdefault(sec, {
+            "section": sec, "deaths": 0, "last_exit_code": None,
+            "restart_disabled": False,
+        })
+        row["deaths"] += 1
+        row["last_exit_code"] = e.get("ExitCode")
+        if float(e.get("RestartInS") or 0.0) < 0:
+            row["restart_disabled"] = True
+    return sorted(
+        by_section.values(), key=lambda r: (-r["deaths"], r["section"])
+    )
 
 
 def hottest_shards(events: list[dict[str, Any]], k: int = 3) -> list[dict]:
@@ -634,6 +661,16 @@ def render_markdown(report: dict) -> str:
                 f"SlowTask: {t.get('slow_task_count', 0)}, "
                 f"blob retries: {t.get('blob_retry_count', 0)}",
             ]
+            deaths = t.get("process_deaths", [])
+            if deaths:
+                lines.append("- supervised process deaths (fdbmonitor):")
+                for d in deaths:
+                    note = (" — restart disabled, stayed dead"
+                            if d.get("restart_disabled") else "")
+                    lines.append(
+                        f"  - `[{d['section']}]`: {d['deaths']} death(s), "
+                        f"last exit {d['last_exit_code']}{note}"
+                    )
             hot = t.get("hottest_shards", [])
             if hot:
                 lines.append("- hottest shards (load-metric plane):")

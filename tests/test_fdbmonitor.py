@@ -1,0 +1,469 @@
+"""tools/fdbmonitor.py — the process supervisor (fdbmonitor analog).
+
+Unit tests drive Monitor.poll() directly against cheap `python -c`
+children (no cluster, no TCP): conf parsing/inheritance, crash-restart
+backoff and its reset, restart-disabled sections, hot-reload diffs
+(including the nasty mid-backoff and mid-crash-loop cases), torn confs,
+and the schema'd trace plane.  One real-fabric test boots a supervised
+coordserver + fdbserver cluster, bounces the server under a live client,
+and proves acked data survives (the rolling-bounce seam end to end)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from foundationdb_tpu.control.status import validate_monitor_event
+from foundationdb_tpu.tools.fdbmonitor import (
+    ConfError,
+    Monitor,
+    parse_conf,
+)
+from foundationdb_tpu.tools.soak import process_deaths, render_markdown
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def write_conf(path, body: str) -> None:
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, str(path))
+
+
+def pump(mon: Monitor, until, timeout: float = 15.0, step: float = 0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        mon.poll()
+        if until():
+            return True
+        time.sleep(step)
+    return False
+
+
+SLEEPER = f"command = {PY} -c \"import time; time.sleep(60)\""
+CRASHER = f"command = {PY} -c \"raise SystemExit(3)\""
+
+
+def base_conf(*sections: str) -> str:
+    return "\n".join(
+        [
+            "[general]",
+            "restart-delay = 0.05",
+            "max-restart-delay = 0.4",
+            "backoff-reset = 5",
+            "conf-poll = 0.05",
+            "kill-grace = 5",
+            "",
+        ]
+        + list(sections)
+    )
+
+
+# -- conf parsing -------------------------------------------------------------
+
+
+def test_parse_conf_inheritance_and_substitution(tmp_path):
+    conf = tmp_path / "m.conf"
+    write_conf(conf, "\n".join([
+        "[general]",
+        "restart-delay = 1",
+        "[worker]",
+        "command = prog serve",
+        "port = $ID",
+        "mode = shared",
+        "env.COMMON = base",
+        "[worker.4001]",
+        "[worker.4002]",
+        "mode = special",
+        "env.EXTRA = $ID",
+        "restart = false",
+    ]))
+    general, specs = parse_conf(str(conf))
+    assert general["restart-delay"] == "1"
+    assert sorted(specs) == ["worker.4001", "worker.4002"]
+    s1, s2 = specs["worker.4001"], specs["worker.4002"]
+    # $ID substitution + base/instance merge, instance keys winning
+    assert s1.argv[:2] == ["prog", "serve"]
+    assert ["--port", "4001"] == s1.argv[s1.argv.index("--port"):][:2]
+    assert ["--mode", "shared"] == s1.argv[s1.argv.index("--mode"):][:2]
+    assert ["--mode", "special"] == s2.argv[s2.argv.index("--mode"):][:2]
+    # env.* keys become the child's env overlay, not argv
+    assert s1.env == {"COMMON": "base"}
+    assert s2.env == {"COMMON": "base", "EXTRA": "4002"}
+    assert not any(a.startswith("--env") for a in s1.argv)
+    # restart is a supervisor directive: parsed, never passed down
+    assert s1.restart and not s2.restart
+    assert "--restart" not in " ".join(s2.argv)
+
+
+def test_parse_conf_ready_file_resolved_and_passed(tmp_path):
+    conf = tmp_path / "m.conf"
+    write_conf(conf, "\n".join([
+        "[w]",
+        "command = prog",
+        "ready-file = run/w.$ID.ready",
+        "[w.1]",
+    ]))
+    _, specs = parse_conf(str(conf))
+    spec = specs["w.1"]
+    # relative ready-file resolves against the CONF dir (children run
+    # there; the supervisor may not) and is passed down as --ready-file
+    assert spec.ready_file == str(tmp_path / "run" / "w.1.ready")
+    i = spec.argv.index("--ready-file")
+    assert spec.argv[i + 1] == spec.ready_file
+
+
+def test_parse_conf_rejects_garbage(tmp_path):
+    conf = tmp_path / "m.conf"
+    write_conf(conf, "[w.1]\nport = 5\n")  # no command
+    with pytest.raises(ConfError):
+        parse_conf(str(conf))
+    write_conf(conf, "not an ini at all [[[")
+    with pytest.raises(ConfError):
+        parse_conf(str(conf))
+    write_conf(conf, "[general]\nrestart-delay = 1\n")  # no process sections
+    with pytest.raises(ConfError):
+        parse_conf(str(conf))
+
+
+# -- supervision --------------------------------------------------------------
+
+
+def make_monitor(tmp_path, *sections: str, status: bool = True) -> Monitor:
+    conf = tmp_path / "m.conf"
+    write_conf(conf, base_conf(*sections))
+    mon = Monitor(
+        str(conf),
+        status_file=str(tmp_path / "status.json") if status else None,
+    )
+    mon.start()
+    return mon
+
+
+def test_crash_restart_backoff_and_disabled(tmp_path):
+    mon = make_monitor(
+        tmp_path,
+        "[crash]", CRASHER, "[crash.1]", "",
+        "[oneshot]", CRASHER, "restart = false", "[oneshot.1]",
+    )
+    try:
+        # the crash-looping child is restarted with escalating delays
+        crash = mon.children["crash.1"]
+        assert pump(mon, lambda: crash.restarts >= 3)
+        died = [e for e in mon.trace.events if e["Type"] == "ProcessDied"
+                and e["Section"] == "crash.1"]
+        delays = [e["RestartInS"] for e in died]
+        assert delays[0] == pytest.approx(0.05, abs=0.01)
+        # escalation doubles and caps at max-restart-delay
+        assert all(b >= a for a, b in zip(delays, delays[1:]))
+        assert max(delays) <= 0.4 + 1e-9
+        assert all(e["ExitCode"] == 3 for e in died)
+        # restart-disabled: exactly one death, stays dead
+        one = mon.children["oneshot.1"]
+        assert pump(mon, lambda: one.state() == "dead")
+        dead_evs = [e for e in mon.trace.events if e["Type"] == "ProcessDied"
+                    and e["Section"] == "oneshot.1"]
+        assert len(dead_evs) == 1 and dead_evs[0]["RestartInS"] == -1.0
+        mon.poll()
+        assert one.state() == "dead"  # no resurrection on later polls
+        # status file reflects both
+        status = json.load(open(tmp_path / "status.json"))
+        assert status["processes"]["oneshot.1"]["state"] == "dead"
+        assert status["processes"]["crash.1"]["restarts"] >= 3
+    finally:
+        mon.shutdown()
+
+
+def test_backoff_resets_after_stable_run(tmp_path):
+    mon = make_monitor(tmp_path, "[w]", SLEEPER, "[w.1]")
+    # stable-run threshold low enough for a test to cross it
+    mon.knobs.MONITOR_BACKOFF_RESET = 0.3
+    try:
+        child = mon.children["w.1"]
+        # two quick kills escalate the delay past the base
+        for _ in range(2):
+            pid = child.pid
+            os.kill(pid, signal.SIGKILL)
+            assert pump(mon, lambda: child.pid not in (None, pid)
+                        and child.proc is not None)
+        assert child.delay > mon.knobs.MONITOR_RESTART_BACKOFF
+        # now let it run past the stability window, then kill again:
+        # the NEXT restart must use the base delay, not the escalated one
+        time.sleep(0.35)
+        pid = child.pid
+        os.kill(pid, signal.SIGKILL)
+        assert pump(mon, lambda: any(
+            e["Type"] == "ProcessDied" and e["Pid"] == pid
+            for e in mon.trace.events))
+        last = [e for e in mon.trace.events
+                if e["Type"] == "ProcessDied" and e["Pid"] == pid][-1]
+        assert last["RestartInS"] == pytest.approx(0.05, abs=0.01)
+    finally:
+        mon.shutdown()
+
+
+def test_hot_reload_add_remove_change(tmp_path):
+    conf = tmp_path / "m.conf"
+    mon = make_monitor(tmp_path, "[w]", SLEEPER, "[w.1]")
+    try:
+        keeper_pid = mon.children["w.1"].pid
+        # ADD a section: exactly the new child starts
+        write_conf(conf, base_conf("[w]", SLEEPER, "[w.1]", "[w.2]"))
+        assert pump(mon, lambda: "w.2" in mon.children
+                    and mon.children["w.2"].proc is not None)
+        assert mon.children["w.1"].pid == keeper_pid  # untouched by contract
+        # CHANGE w.2's argv: bounced now, with a new pid
+        pid2 = mon.children["w.2"].pid
+        write_conf(conf, base_conf(
+            "[w]", SLEEPER, "[w.1]", "[w.2]",
+            f"command = {PY} -c \"import time; time.sleep(61)\""))
+        assert pump(mon, lambda: mon.children["w.2"].pid not in (None, pid2))
+        assert mon.children["w.1"].pid == keeper_pid
+        # REMOVE w.2: stopped and forgotten
+        write_conf(conf, base_conf("[w]", SLEEPER, "[w.1]"))
+        assert pump(mon, lambda: "w.2" not in mon.children)
+        assert mon.children["w.1"].pid == keeper_pid
+        reloads = [e for e in mon.trace.events if e["Type"] == "ConfReloaded"]
+        assert [r["Added"] for r in reloads] == ["w.2", "", ""]
+        assert [r["Removed"] for r in reloads] == ["", "", "w.2"]
+        assert [r["Changed"] for r in reloads] == ["", "w.2", ""]
+    finally:
+        mon.shutdown()
+
+
+def test_hot_reload_remove_during_backoff(tmp_path):
+    conf = tmp_path / "m.conf"
+    mon = make_monitor(tmp_path, "[w]", SLEEPER, "[w.1]", "",
+                       "[crash]", CRASHER, "[crash.1]")
+    mon.knobs.MONITOR_RESTART_BACKOFF = 2.0  # park the crasher in backoff
+    try:
+        crash = mon.children["crash.1"]
+        assert pump(mon, lambda: crash.state() == "backoff")
+        # removing a section whose child is mid-backoff just forgets the
+        # pending restart — nothing to kill, nothing respawns later
+        write_conf(conf, base_conf("[w]", SLEEPER, "[w.1]"))
+        assert pump(mon, lambda: "crash.1" not in mon.children)
+        deaths_before = sum(1 for e in mon.trace.events
+                            if e["Type"] == "ProcessDied")
+        time.sleep(0.15)
+        mon.poll()
+        deaths_after = sum(1 for e in mon.trace.events
+                           if e["Type"] == "ProcessDied")
+        assert deaths_after == deaths_before
+    finally:
+        mon.shutdown()
+
+
+def test_hot_reload_param_change_during_crash_loop(tmp_path):
+    conf = tmp_path / "m.conf"
+    marker = tmp_path / "fixed.marker"
+    mon = make_monitor(tmp_path, "[crash]", CRASHER, "[crash.1]")
+    mon.knobs.MONITOR_RESTART_BACKOFF = 0.3  # stay in backoff long enough
+    try:
+        crash = mon.children["crash.1"]
+        assert pump(mon, lambda: crash.state() == "backoff")
+        # the operator fixes the command while the child is in backoff:
+        # the ALREADY-SCHEDULED restart must pick up the new argv
+        write_conf(conf, base_conf(
+            "[crash]",
+            f"command = {PY} -c \"import sys, time; "
+            f"open({str(marker)!r}, 'w').close(); time.sleep(60)\"",
+            "[crash.1]",
+        ))
+        assert pump(mon, lambda: marker.exists() and crash.proc is not None)
+        assert crash.state() == "running"
+    finally:
+        mon.shutdown()
+
+
+def test_torn_conf_keeps_last_good(tmp_path):
+    conf = tmp_path / "m.conf"
+    mon = make_monitor(tmp_path, "[w]", SLEEPER, "[w.1]")
+    try:
+        pid = mon.children["w.1"].pid
+        # a torn write (half an ini) must not kill the world: the last
+        # good conf stays in force and the bad content traces ONCE
+        write_conf(conf, "[w]\ncommand = ")
+        assert pump(mon, lambda: any(
+            e["Type"] == "MonitorConfInvalid" for e in mon.trace.events))
+        n = sum(1 for e in mon.trace.events
+                if e["Type"] == "MonitorConfInvalid")
+        for _ in range(5):
+            mon.poll()
+            time.sleep(0.02)
+        assert sum(1 for e in mon.trace.events
+                   if e["Type"] == "MonitorConfInvalid") == n
+        assert mon.children["w.1"].pid == pid
+        assert mon.children["w.1"].state() == "running"
+        # the repaired conf reloads normally
+        write_conf(conf, base_conf("[w]", SLEEPER, "[w.1]", "[w.2]"))
+        assert pump(mon, lambda: "w.2" in mon.children)
+        assert mon.children["w.1"].pid == pid
+    finally:
+        mon.shutdown()
+
+
+def test_sighup_triggers_reload_and_events_validate(tmp_path):
+    conf = tmp_path / "m.conf"
+    mon = make_monitor(tmp_path, "[w]", SLEEPER, "[w.1]")
+    try:
+        # SIGHUP path: the flag forces a reload even with identical bytes
+        mon._hup = True
+        mon.poll()
+        assert any(e["Type"] == "ConfReloaded" for e in mon.trace.events)
+        os.kill(mon.children["w.1"].pid, signal.SIGKILL)
+        assert pump(mon, lambda: any(
+            e["Type"] == "ProcessDied" for e in mon.trace.events))
+        write_conf(conf, "totally [[ torn")
+        assert pump(mon, lambda: any(
+            e["Type"] == "MonitorConfInvalid" for e in mon.trace.events))
+    finally:
+        mon.shutdown()
+    # every event the supervisor ever emits is schema-valid — and this
+    # run covered started/died/restarted/stopped/reloaded/invalid/stopped
+    types = {e["Type"] for e in mon.trace.events}
+    assert {"MonitorStarted", "ProcessStarted", "ProcessDied",
+            "ConfReloaded", "MonitorConfInvalid", "ProcessStopped",
+            "MonitorStopped"} <= types
+    for e in mon.trace.events:
+        validate_monitor_event(e)
+
+
+def test_spawn_failure_backs_off(tmp_path):
+    mon = make_monitor(
+        tmp_path, "[w]", "command = /nonexistent/binary-xyzzy", "[w.1]")
+    try:
+        child = mon.children["w.1"]
+        assert child.proc is None
+        assert pump(mon, lambda: sum(
+            1 for e in mon.trace.events
+            if e["Type"] == "ProcessSpawnFailed") >= 2)
+        assert child.state() == "backoff"
+    finally:
+        mon.shutdown()
+
+
+def test_soak_folds_process_deaths(tmp_path):
+    mon = make_monitor(tmp_path, "[crash]", CRASHER, "[crash.1]", "",
+                       "[oneshot]", CRASHER, "restart = false",
+                       "[oneshot.1]")
+    try:
+        assert pump(mon, lambda: mon.children["crash.1"].restarts >= 2
+                    and mon.children["oneshot.1"].state() == "dead")
+    finally:
+        mon.shutdown()
+    rows = process_deaths(list(mon.trace.events))
+    by_sec = {r["section"]: r for r in rows}
+    assert by_sec["crash.1"]["deaths"] >= 2
+    assert by_sec["crash.1"]["last_exit_code"] == 3
+    assert not by_sec["crash.1"]["restart_disabled"]
+    assert by_sec["oneshot.1"]["restart_disabled"]
+    # most-deaths-first ordering feeds the triage report
+    assert rows[0]["section"] == "crash.1"
+    md = render_markdown({
+        "spec": "monitor-fold", "seeds": [0], "jobs": 1, "wall_s": 0.0,
+        "ok": False,
+        "verdicts": {"pass": 0, "fail": 1, "timeout": 0, "crash": 0},
+        "coverage": {"required": [], "missing_required": [],
+                     "merged": {"buggify": {}, "testcov": {}}},
+        "per_seed": [{"seed": 0, "verdict": "fail", "wall_s": 0.0,
+                      "error": "x",
+                      "triage": {"process_deaths": rows}}],
+    })
+    assert "supervised process deaths (fdbmonitor)" in md
+    assert "restart disabled, stayed dead" in md
+
+
+# -- the real fabric ----------------------------------------------------------
+
+
+def test_supervised_cluster_server_bounce(tmp_path):
+    """End-to-end rolling-bounce seam on real TCP: a supervised
+    coordserver + fdbserver cluster; the server is SIGTERMed under a live
+    gateway client and acked data must survive the bounce (restart image
+    + durable coordinator registers + client reconnect)."""
+    import socket
+
+    from foundationdb_tpu.client.gateway_client import GatewayClient
+    from foundationdb_tpu.client.cluster_file import write_cluster_file
+    from foundationdb_tpu.rpc.network import NetworkAddress
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    coord_port, gw_port = free_port(), free_port()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    os.environ["PYTHONPATH"] = (
+        REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    write_cluster_file(str(tmp_path / "fdb.cluster"),
+                       [NetworkAddress("127.0.0.1", coord_port)])
+    conf = tmp_path / "m.conf"
+    write_conf(conf, "\n".join([
+        "[general]",
+        "restart-delay = 0.25",
+        "conf-poll = 0.2",
+        "kill-grace = 20",
+        "logdir = logs",
+        "",
+        "[coordserver]",
+        f"command = {PY} -m foundationdb_tpu.tools.coordserver",
+        "port = $ID",
+        "run-seconds = 300",
+        "ready-file = logs/coord.$ID.ready",
+        "store-dir = logs/coord.$ID.store",
+        f"[coordserver.{coord_port}]",
+        "",
+        "[fdbserver]",
+        f"command = {PY} -m foundationdb_tpu.tools.server",
+        "port = $ID",
+        "cluster-file = fdb.cluster",
+        "shards = 1",
+        "replication = 1",
+        "workers = 0",
+        "engine = memory",
+        "image-dir = image",
+        "ready-file = logs/server.ready",
+        "run-seconds = 300",
+        f"[fdbserver.{gw_port}]",
+    ]))
+    mon = Monitor(str(conf), status_file=str(tmp_path / "status.json"))
+    mon.start()
+    try:
+        assert pump(mon, lambda: all(
+            mon._ready(c) for c in mon.children.values()), timeout=120.0)
+        db = GatewayClient("127.0.0.1", gw_port, timeout=30.0,
+                           reconnect_window=60.0)
+        try:
+            db.run(lambda tr: tr.set(b"bounce/k", b"v1"))
+            server = mon.children[f"fdbserver.{gw_port}"]
+            pid = server.pid
+            os.kill(pid, signal.SIGTERM)
+            assert pump(mon, lambda: server.pid not in (None, pid)
+                        and mon._ready(server), timeout=120.0)
+            # the SAME client rides its reconnect path across the bounce;
+            # the acked write survived via the restart image
+            assert db.run(lambda tr: tr.get(b"bounce/k")) == b"v1"
+            db.run(lambda tr: tr.set(b"bounce/k2", b"v2"))
+            assert db.read(lambda tr: tr.get(b"bounce/k2")) == b"v2"
+        finally:
+            db.close()
+        died = [e for e in mon.trace.events if e["Type"] == "ProcessDied"]
+        assert [e["Section"] for e in died] == [f"fdbserver.{gw_port}"]
+        for e in mon.trace.events:
+            validate_monitor_event(e)
+    finally:
+        mon.shutdown()
+    # shutdown stopped everything: no stray children
+    assert all(c.proc is None for c in mon.children.values())
